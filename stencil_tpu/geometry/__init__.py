@@ -1,0 +1,37 @@
+from .dim3 import CORNER_DIRS, DIRECTIONS_26, Dim3, EDGE_DIRS, FACE_DIRS
+from .numeric import div_ceil, max_abs_error, next_power_of_two, prime_factors
+from .partition import NodePartition, RankPartition
+from .radius import Radius
+from .rect3 import Rect3
+from .region import (
+    compute_offset,
+    exterior_regions,
+    halo_extent,
+    halo_pos,
+    halo_rect,
+    interior_region,
+    raw_size,
+)
+
+__all__ = [
+    "CORNER_DIRS",
+    "DIRECTIONS_26",
+    "Dim3",
+    "EDGE_DIRS",
+    "FACE_DIRS",
+    "NodePartition",
+    "RankPartition",
+    "Radius",
+    "Rect3",
+    "compute_offset",
+    "div_ceil",
+    "exterior_regions",
+    "halo_extent",
+    "halo_pos",
+    "halo_rect",
+    "interior_region",
+    "max_abs_error",
+    "next_power_of_two",
+    "prime_factors",
+    "raw_size",
+]

@@ -1,0 +1,60 @@
+"""Small integer helpers used by the partitioners.
+
+TPU-native re-implementation of the reference's numeric utilities
+(reference: include/stencil/numeric.hpp, src/numeric.cpp). These are pure
+host-side integer math used at plan time, never traced by JAX.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def prime_factors(n: int) -> list[int]:
+    """Prime factorization of ``n``, sorted largest-first.
+
+    The largest-first order matters: the partitioners split the domain by one
+    prime factor at a time, and splitting by the biggest factor first yields
+    the reference's exact subdomain shapes (reference: src/numeric.cpp:7-26).
+    """
+    if n < 1:
+        raise ValueError(f"prime_factors requires n >= 1, got {n}")
+    factors: list[int] = []
+    remaining = n
+    p = 2
+    while p * p <= remaining:
+        while remaining % p == 0:
+            factors.append(p)
+            remaining //= p
+        p += 1
+    if remaining > 1:
+        factors.append(remaining)
+    factors.sort(reverse=True)
+    return factors
+
+
+def div_ceil(n: int, d: int) -> int:
+    """Ceiling division (reference: include/stencil/numeric.hpp:25)."""
+    return -(-n // d)
+
+
+def next_power_of_two(x: int) -> int:
+    """Smallest power of two >= x (reference: include/stencil/numeric.hpp:9-19)."""
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def max_abs_error(a, b) -> float:
+    """Largest elementwise absolute difference between two sequences
+    (reference: include/stencil/numeric.hpp:27-33)."""
+    return max((abs(x - y) for x, y in zip(a, b)), default=0.0)
+
+
+def trimean_weights(n: int) -> list[float]:
+    # helper kept here to avoid a utils<->geometry cycle; see utils.statistics
+    raise NotImplementedError
+
+
+def isqrt(n: int) -> int:
+    return math.isqrt(n)

@@ -1,0 +1,4 @@
+from . import logging, timer
+from .statistics import Statistics
+
+__all__ = ["Statistics", "logging", "timer"]

@@ -1,0 +1,71 @@
+"""Leveled stderr logging with a process-index prefix.
+
+TPU-native analogue of the reference's compile-time leveled logging
+(reference: include/stencil/logging.hpp:8-53). Instead of a CMake-time
+level, the level is read from the ``STENCIL_LOG_LEVEL`` environment variable
+(SPEW|DEBUG|INFO|WARN|ERROR|FATAL, default INFO) and may be changed at
+runtime with :func:`set_level`. ``fatal`` raises instead of ``exit(1)`` so
+library users can handle errors.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+SPEW, DEBUG, INFO, WARN, ERROR, FATAL = 0, 1, 2, 3, 4, 5
+_NAMES = {"SPEW": SPEW, "DEBUG": DEBUG, "INFO": INFO, "WARN": WARN, "ERROR": ERROR, "FATAL": FATAL}
+_LEVEL = _NAMES.get(os.environ.get("STENCIL_LOG_LEVEL", "INFO").upper(), INFO)
+
+
+class FatalError(RuntimeError):
+    pass
+
+
+def set_level(level) -> None:
+    global _LEVEL
+    _LEVEL = _NAMES[level.upper()] if isinstance(level, str) else int(level)
+
+
+def get_level() -> int:
+    return _LEVEL
+
+
+def _prefix(tag: str) -> str:
+    try:
+        import jax
+
+        pid = jax.process_index()
+    except Exception:
+        pid = 0
+    return f"[{tag}] p{pid}: "
+
+
+def _emit(level: int, tag: str, msg: str) -> None:
+    if level >= _LEVEL:
+        print(_prefix(tag) + str(msg), file=sys.stderr)
+
+
+def spew(msg):
+    _emit(SPEW, "SPEW", msg)
+
+
+def debug(msg):
+    _emit(DEBUG, "DEBUG", msg)
+
+
+def info(msg):
+    _emit(INFO, "INFO", msg)
+
+
+def warn(msg):
+    _emit(WARN, "WARN", msg)
+
+
+def error(msg):
+    _emit(ERROR, "ERROR", msg)
+
+
+def fatal(msg):
+    _emit(FATAL, "FATAL", msg)
+    raise FatalError(str(msg))

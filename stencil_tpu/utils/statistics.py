@@ -1,0 +1,57 @@
+"""Benchmark statistics: min/max/avg/median/stddev and the trimean.
+
+TPU-native re-implementation of the reference's Statistics helper
+(reference: bin/statistics.hpp:6-19, bin/statistics.cpp). The *trimean*
+(Tukey's (Q1 + 2*Q2 + Q3) / 4) is the canonical reported statistic for all
+benchmarks, as in the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable
+
+
+class Statistics:
+    def __init__(self, values: Iterable[float] = ()):  # noqa: D401
+        self._v: list[float] = sorted(float(v) for v in values)
+
+    def insert(self, v: float) -> None:
+        import bisect
+
+        bisect.insort(self._v, float(v))
+
+    def count(self) -> int:
+        return len(self._v)
+
+    def min(self) -> float:
+        return self._v[0]
+
+    def max(self) -> float:
+        return self._v[-1]
+
+    def avg(self) -> float:
+        return sum(self._v) / len(self._v)
+
+    def stddev(self) -> float:
+        m = self.avg()
+        return math.sqrt(sum((v - m) ** 2 for v in self._v) / len(self._v))
+
+    def _quantile(self, q: float) -> float:
+        """Linear-interpolated quantile over the sorted samples."""
+        v = self._v
+        if len(v) == 1:
+            return v[0]
+        pos = q * (len(v) - 1)
+        lo = int(math.floor(pos))
+        hi = min(lo + 1, len(v) - 1)
+        frac = pos - lo
+        return v[lo] * (1 - frac) + v[hi] * frac
+
+    def med(self) -> float:
+        return self._quantile(0.5)
+
+    def trimean(self) -> float:
+        """Tukey's trimean — the reference's headline statistic
+        (reference: bin/statistics.hpp:17)."""
+        return (self._quantile(0.25) + 2 * self._quantile(0.5) + self._quantile(0.75)) / 4

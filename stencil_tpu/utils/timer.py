@@ -1,0 +1,58 @@
+"""Phase timers and profiler range annotations.
+
+TPU-native analogue of the reference's pass-through CUDA/MPI timers and
+NVTX ranges (reference: include/stencil/rt.hpp:9-36,
+include/stencil/timer.hpp:44-47, nvtxRangePush/Pop throughout
+src/stencil.cu). On TPU the profiler annotation is
+``jax.profiler.TraceAnnotation``; accumulated wall-clock buckets replace the
+global ``timers::cudaRuntime``/``timers::mpi`` counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+# global accumulated seconds per named bucket (reference: timer.hpp:44-47)
+buckets: dict[str, float] = defaultdict(float)
+
+
+def reset() -> None:
+    buckets.clear()
+
+
+@contextlib.contextmanager
+def timed(bucket: str):
+    """Accumulate elapsed wall time into ``buckets[bucket]``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        buckets[bucket] += time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    """Named profiler range (NVTX analogue: jax.profiler.TraceAnnotation)."""
+    try:
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:
+        yield
+
+
+def time_fn(bucket: str):
+    """Decorator: accumulate a function's wall time into a bucket."""
+
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            with timed(bucket):
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+    return deco

@@ -1,0 +1,17 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+This is the TPU build's analogue of the reference's trick of running 2 MPI
+ranks / multiple subdomains per GPU on one node to exercise distributed
+paths without a cluster (reference: test/CMakeLists.txt:49,
+test/test_exchange.cu:52). ``xla_force_host_platform_device_count=8`` gives
+8 virtual devices so 2x2x2 meshes run anywhere.
+
+Must set the env vars before JAX initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

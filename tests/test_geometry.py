@@ -1,0 +1,109 @@
+"""Dim3 / Radius / halo geometry / interior-exterior tests.
+
+Ports the semantics pinned by the reference's unit tests
+(test/test_cpu_radius.cpp, test/test_cuda_local_domain.cu) and the region
+math of src/stencil.cu:878-977.
+"""
+
+from stencil_tpu.geometry import (
+    DIRECTIONS_26,
+    Dim3,
+    Radius,
+    Rect3,
+    compute_offset,
+    exterior_regions,
+    halo_extent,
+    halo_pos,
+    interior_region,
+    raw_size,
+)
+
+
+def test_dim3_wrap():
+    # reference: dim3.hpp:208-230
+    assert Dim3(10, -1, 5).wrap(Dim3(5, 5, 5)) == Dim3(0, 4, 0)
+    assert Dim3(-6, 7, 4).wrap(Dim3(5, 5, 5)) == Dim3(4, 2, 4)
+
+
+def test_directions_26():
+    assert len(DIRECTIONS_26) == 26
+    assert Dim3(0, 0, 0) not in DIRECTIONS_26
+
+
+def test_radius_constant_and_fec():
+    r = Radius.constant(3)
+    assert r.x(1) == 3 and r.y(-1) == 3 and r.dir(1, 1, 1) == 3
+    r2 = Radius.face_edge_corner(2, 1, 0)
+    assert r2.x(1) == 2
+    assert r2.dir(1, 1, 0) == 1
+    assert r2.dir(1, 1, 1) == 0
+    assert r2.dir(0, 0, 0) == 0
+
+
+def test_halo_extent_uses_face_radii():
+    # reference: local_domain.cuh:212-222 — extents use face radii even for
+    # edge/corner directions
+    r = Radius.face_edge_corner(2, 1, 1)
+    sz = Dim3(10, 20, 30)
+    assert halo_extent((1, 0, 0), sz, r) == Dim3(2, 20, 30)
+    assert halo_extent((1, 1, 0), sz, r) == Dim3(2, 2, 30)
+    assert halo_extent((1, 1, 1), sz, r) == Dim3(2, 2, 2)
+    assert halo_extent((0, 0, 0), sz, r) == sz
+
+
+def test_halo_pos_asymmetric():
+    # reference: src/local_domain.cu:86-129
+    r = Radius.constant(0)
+    r.set_dir((1, 0, 0), 2)   # +x face radius 2
+    r.set_dir((-1, 0, 0), 1)  # -x face radius 1
+    sz = Dim3(10, 10, 10)
+    # +x halo sits past the left pad + interior
+    assert halo_pos((1, 0, 0), sz, r, halo=True) == Dim3(10 + 1, 0, 0)
+    # +x exterior (boundary interior) starts at left pad + interior - nothing:
+    assert halo_pos((1, 0, 0), sz, r, halo=False) == Dim3(10, 0, 0)
+    # -x halo is at the very edge; -x exterior just inside the pad
+    assert halo_pos((-1, 0, 0), sz, r, halo=True) == Dim3(0, 0, 0)
+    assert halo_pos((-1, 0, 0), sz, r, halo=False) == Dim3(1, 0, 0)
+    assert raw_size(sz, r) == Dim3(13, 10, 10)
+    assert compute_offset(r) == Dim3(1, 0, 0)
+
+
+def test_interior_exterior_partition_compute_region():
+    # interior + exteriors exactly tile the compute region, disjointly
+    # (reference: src/stencil.cu:878-977)
+    r = Radius.constant(2)
+    compute = Rect3.of((0, 0, 0), (10, 12, 8))
+    interior = interior_region(compute, r)
+    assert interior == Rect3.of((2, 2, 2), (8, 10, 6))
+    exts = exterior_regions(compute, interior)
+    assert len(exts) == 6
+    total = interior.num_points() + sum(e.num_points() for e in exts)
+    assert total == compute.num_points()
+    # disjointness via point sampling
+    seen = set()
+    for reg in [interior] + exts:
+        for z in range(reg.lo.z, reg.hi.z):
+            for y in range(reg.lo.y, reg.hi.y):
+                for x in range(reg.lo.x, reg.hi.x):
+                    assert (x, y, z) not in seen
+                    seen.add((x, y, z))
+    assert len(seen) == compute.num_points()
+
+
+def test_interior_asymmetric_radius():
+    r = Radius.constant(0)
+    r.set_dir((1, 0, 0), 3)
+    compute = Rect3.of((0, 0, 0), (10, 10, 10))
+    interior = interior_region(compute, r)
+    # only the +x side pulls in
+    assert interior == Rect3.of((0, 0, 0), (7, 10, 10))
+    exts = exterior_regions(compute, interior)
+    assert len(exts) == 1
+    assert exts[0] == Rect3.of((7, 0, 0), (10, 10, 10))
+
+
+def test_zero_radius_interior_is_compute():
+    r = Radius.constant(0)
+    compute = Rect3.of((0, 0, 0), (5, 5, 5))
+    assert interior_region(compute, r) == compute
+    assert exterior_regions(compute, compute) == []

@@ -1,0 +1,111 @@
+"""Partition math tests ported from the reference's exact expectations
+(reference: test/test_cpu_partition.cpp:7-73) plus NodePartition behavior."""
+
+from stencil_tpu.geometry import Dim3, NodePartition, Radius, RankPartition, prime_factors
+
+
+def test_prime_factors_sorted_desc():
+    assert prime_factors(1) == []
+    assert prime_factors(2) == [2]
+    assert prime_factors(12) == [3, 2, 2]
+    assert prime_factors(9) == [3, 3]
+    assert prime_factors(13) == [13]
+
+
+def test_10x5x5_into_2():
+    part = RankPartition((10, 5, 5), 2)
+    assert part.dim() == Dim3(2, 1, 1)
+    assert part.subdomain_size((0, 0, 0)) == Dim3(5, 5, 5)
+    assert part.subdomain_size((1, 0, 0)) == Dim3(5, 5, 5)
+
+
+def test_10x3x1_into_4():
+    part = RankPartition((10, 3, 1), 4)
+    assert part.subdomain_size((0, 0, 0)) == Dim3(3, 3, 1)
+    assert part.subdomain_size((1, 0, 0)) == Dim3(3, 3, 1)
+    assert part.subdomain_size((2, 0, 0)) == Dim3(2, 3, 1)
+    assert part.subdomain_size((3, 0, 0)) == Dim3(2, 3, 1)
+    assert part.subdomain_origin((0, 0, 0)) == Dim3(0, 0, 0)
+    assert part.subdomain_origin((1, 0, 0)) == Dim3(3, 0, 0)
+    assert part.subdomain_origin((2, 0, 0)) == Dim3(6, 0, 0)
+    assert part.subdomain_origin((3, 0, 0)) == Dim3(8, 0, 0)
+
+
+def test_10x5x5_into_3():
+    part = RankPartition((10, 5, 5), 3)
+    assert part.subdomain_size((0, 0, 0)) == Dim3(4, 5, 5)
+    assert part.subdomain_size((1, 0, 0)) == Dim3(3, 5, 5)
+    assert part.subdomain_size((2, 0, 0)) == Dim3(3, 5, 5)
+
+
+def test_13x7x7_into_4():
+    part = RankPartition((13, 7, 7), 4)
+    assert part.subdomain_size((0, 0, 0)) == Dim3(4, 7, 7)
+    assert part.subdomain_size((1, 0, 0)) == Dim3(3, 7, 7)
+    assert part.subdomain_size((2, 0, 0)) == Dim3(3, 7, 7)
+    assert part.subdomain_size((3, 0, 0)) == Dim3(3, 7, 7)
+
+
+def test_10x14x2_into_9():
+    part = RankPartition((10, 14, 2), 9)
+    assert part.subdomain_origin((0, 0, 0)) == Dim3(0, 0, 0)
+    assert part.subdomain_origin((1, 1, 0)) == Dim3(4, 5, 0)
+    assert part.subdomain_origin((2, 2, 0)) == Dim3(7, 10, 0)
+
+
+def test_linearize_roundtrip():
+    part = RankPartition((8, 8, 8), 8)
+    n = part.dim().flatten()
+    assert n == 8
+    for i in range(n):
+        assert part.linearize(part.dimensionize(i)) == i
+
+
+def test_subdomains_tile_global_domain():
+    """Every global cell belongs to exactly one subdomain."""
+    size = Dim3(13, 7, 5)
+    part = RankPartition(size, 6)
+    seen = set()
+    d = part.dim()
+    for z in range(d.z):
+        for y in range(d.y):
+            for x in range(d.x):
+                idx = Dim3(x, y, z)
+                o = part.subdomain_origin(idx)
+                s = part.subdomain_size(idx)
+                for pz in range(o.z, o.z + s.z):
+                    for py in range(o.y, o.y + s.y):
+                        for px in range(o.x, o.x + s.x):
+                            p = (px, py, pz)
+                            assert p not in seen
+                            seen.add(p)
+    assert len(seen) == size.flatten()
+
+
+def test_node_partition_min_interface():
+    # with a uniform radius, NodePartition cuts the axis with the smallest
+    # orthogonal area first: for a long-x box that is the x axis
+    # (reference: partition.hpp:167-208)
+    part = NodePartition((64, 16, 16), Radius.constant(1), 2, 2)
+    assert part.sys_dim() == Dim3(2, 1, 1)
+    assert part.node_dim() == Dim3(2, 1, 1)
+    assert part.base_size() == Dim3(16, 16, 16)
+
+
+def test_node_partition_radius_weighting():
+    # zero radius in x makes the x interface free, so splits prefer x even
+    # when x is short
+    r = Radius.constant(2)
+    for d in ((1, 0, 0), (-1, 0, 0)):
+        r.set_dir(d, 0)
+    part = NodePartition((8, 64, 64), r, 4, 1)
+    assert part.sys_dim() == Dim3(4, 1, 1)
+
+
+def test_node_partition_uneven():
+    part = NodePartition((10, 10, 10), Radius.constant(1), 3, 1)
+    sizes = [part.subdomain_size((i, 0, 0)).x for i in range(3)]
+    origins = [part.subdomain_origin((i, 0, 0)).x for i in range(3)]
+    assert sizes == [4, 3, 3]
+    assert origins == [0, 4, 7]
+    assert not part.is_uniform()

@@ -1,0 +1,23 @@
+from stencil_tpu.utils import Statistics
+
+
+def test_basic_stats():
+    s = Statistics([1.0, 2.0, 3.0, 4.0])
+    assert s.min() == 1.0
+    assert s.max() == 4.0
+    assert s.avg() == 2.5
+    assert s.med() == 2.5
+    assert s.count() == 4
+
+
+def test_trimean():
+    # trimean of 1..5: Q1=2, med=3, Q3=4 -> (2 + 6 + 4)/4 = 3
+    s = Statistics([1, 2, 3, 4, 5])
+    assert s.trimean() == 3.0
+
+
+def test_insert_keeps_sorted():
+    s = Statistics([3.0])
+    s.insert(1.0)
+    s.insert(2.0)
+    assert s.min() == 1.0 and s.max() == 3.0
