@@ -1,0 +1,11 @@
+from .handle import DataHandle
+from .block import LocalBlock, block_compute_slices, block_rect_slices
+from .grid import GridSpec
+
+__all__ = [
+    "DataHandle",
+    "GridSpec",
+    "LocalBlock",
+    "block_compute_slices",
+    "block_rect_slices",
+]

@@ -1,0 +1,149 @@
+"""LocalBlock — one subdomain's quantities as halo-padded JAX arrays.
+
+TPU-native re-design of the reference's ``LocalDomain``
+(reference: include/stencil/local_domain.cuh:34-276, src/local_domain.cu).
+The reference cudaMallocs a pitched curr/next allocation per quantity and
+does byte-offset pointer math; here each quantity is a dense ``jnp`` array of
+shape ``raw_size = size + radius⁻ + radius⁺`` (z, y, x fastest-varying last,
+so XLA's (8,128) tiling lands on the y/x plane), and the curr/next double
+buffer is a pair of pytrees swapped functionally (``swap()`` ≡ exchanging the
+dict references; under ``jit`` this becomes input/output buffer aliasing
+rather than a device-side pointer-array flip, src/local_domain.cu:67-84).
+
+Array axis order is ``[z, y, x]`` throughout the framework (the reference
+indexes ``z*ysize*pitch + y*pitch + x``, pitched_ptr.hpp:52 — same
+memory order).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..geometry import Dim3, Radius, Rect3, compute_offset, halo_rect, raw_size
+from .handle import DataHandle
+
+
+def block_rect_slices(rect: Rect3) -> Tuple[slice, slice, slice]:
+    """Slices selecting an allocation-local ``Rect3`` from a [z,y,x] array."""
+    return (
+        slice(rect.lo.z, rect.hi.z),
+        slice(rect.lo.y, rect.hi.y),
+        slice(rect.lo.x, rect.hi.x),
+    )
+
+
+def block_compute_slices(size, radius: Radius) -> Tuple[slice, slice, slice]:
+    """Slices selecting the compute (interior, non-halo) region.
+
+    The accessor-origin math of the reference (`local_domain.cuh:153-173`:
+    origin = −negative-face radius) collapses to "offset every coordinate by
+    the negative-side radius", i.e. this slice.
+    """
+    sz = Dim3.of(size)
+    off = compute_offset(radius)
+    return (
+        slice(off.z, off.z + sz.z),
+        slice(off.y, off.y + sz.y),
+        slice(off.x, off.x + sz.x),
+    )
+
+
+class LocalBlock:
+    """All quantities of one subdomain, halo-padded, double-buffered.
+
+    Mirrors the reference ``LocalDomain`` API surface: ``add_data`` →
+    ``realize`` → ``get_curr``/``get_next`` → ``swap``; geometry queries
+    (``raw_size``, ``halo_rect`` …) delegate to :mod:`stencil_tpu.geometry`.
+    """
+
+    def __init__(self, size, origin, radius: Optional[Radius] = None):
+        self.size = Dim3.of(size)
+        self.origin = Dim3.of(origin)
+        self.radius = radius if radius is not None else Radius.constant(0)
+        self._handles: List[DataHandle] = []
+        self._curr: Dict[int, jnp.ndarray] = {}
+        self._next: Dict[int, jnp.ndarray] = {}
+        self._realized = False
+
+    # -- setup (reference: local_domain.cuh:85-107) -------------------------
+    def set_radius(self, radius: Radius) -> None:
+        assert not self._realized
+        self.radius = radius
+
+    def add_data(self, name: str = "", dtype="float32") -> DataHandle:
+        assert not self._realized, "add_data after realize"
+        h = DataHandle(len(self._handles), name or f"q{len(self._handles)}", str(jnp.dtype(dtype)))
+        self._handles.append(h)
+        return h
+
+    def realize(self) -> None:
+        """Allocate curr+next zero arrays per quantity
+        (reference: src/local_domain.cu:159-220)."""
+        shape = self.raw_size().as_tuple()[::-1]  # [z, y, x]
+        for h in self._handles:
+            self._curr[h.idx] = jnp.zeros(shape, dtype=h.dtype)
+            self._next[h.idx] = jnp.zeros(shape, dtype=h.dtype)
+        self._realized = True
+
+    # -- geometry -----------------------------------------------------------
+    def raw_size(self) -> Dim3:
+        return raw_size(self.size, self.radius)
+
+    def num_data(self) -> int:
+        return len(self._handles)
+
+    def handles(self) -> Tuple[DataHandle, ...]:
+        return tuple(self._handles)
+
+    def compute_slices(self) -> Tuple[slice, slice, slice]:
+        return block_compute_slices(self.size, self.radius)
+
+    def halo_region(self, direction, halo: bool) -> Rect3:
+        """Allocation-local halo (``halo=True``) or matching interior-edge
+        region (reference: src/local_domain.cu:86-129)."""
+        return halo_rect(direction, self.size, self.radius, halo)
+
+    # -- data access --------------------------------------------------------
+    def get_curr(self, h: DataHandle) -> jnp.ndarray:
+        return self._curr[h.idx]
+
+    def get_next(self, h: DataHandle) -> jnp.ndarray:
+        return self._next[h.idx]
+
+    def set_curr(self, h: DataHandle, arr) -> None:
+        assert arr.shape == self.raw_size().as_tuple()[::-1], (
+            f"shape {arr.shape} != padded {self.raw_size().as_tuple()[::-1]}"
+        )
+        self._curr[h.idx] = arr
+
+    def set_next(self, h: DataHandle, arr) -> None:
+        assert arr.shape == self.raw_size().as_tuple()[::-1]
+        self._next[h.idx] = arr
+
+    def curr_tree(self) -> Dict[int, jnp.ndarray]:
+        return dict(self._curr)
+
+    def next_tree(self) -> Dict[int, jnp.ndarray]:
+        return dict(self._next)
+
+    def swap(self) -> None:
+        """Exchange curr/next (reference: src/local_domain.cu:67-84). A pure
+        host-side reference swap — no device work."""
+        self._curr, self._next = self._next, self._curr
+
+    # -- host transfer (reference: local_domain.cuh:264-273, region_to_host)
+    def quantity_to_host(self, h: DataHandle, curr: bool = True) -> np.ndarray:
+        """Full padded region including halos, as numpy [z,y,x]."""
+        src = self._curr if curr else self._next
+        return np.asarray(src[h.idx])
+
+    def region_to_host(self, h: DataHandle, rect: Rect3, curr: bool = True) -> np.ndarray:
+        src = self._curr if curr else self._next
+        return np.asarray(src[h.idx][block_rect_slices(rect)])
+
+    def interior_to_host(self, h: DataHandle, curr: bool = True) -> np.ndarray:
+        src = self._curr if curr else self._next
+        return np.asarray(src[h.idx][self.compute_slices()])

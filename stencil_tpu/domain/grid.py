@@ -1,0 +1,99 @@
+"""GridSpec — the static layout of a partitioned, halo-padded 3D grid.
+
+Bundles what the reference scatters across ``DistributedDomain``/
+``Placement``/``LocalDomain`` geometry state (reference:
+include/stencil/stencil.hpp:33-122, include/stencil/partition.hpp:264-289):
+the global extent, the partition grid, per-block logical sizes/origins
+(uneven splits follow the reference's remainder rule, partition.hpp:55-86),
+the per-direction radius, and the padded block shape.
+
+Because the partition is a tensor product (each axis is split
+independently), per-block sizes factor into three per-axis size lists —
+this is what makes uneven blocks exchangeable with axis-aligned collective
+permutes: blocks in the same ring share the orthogonal-axis sizes.
+
+Array layout convention: JAX arrays are indexed ``[z, y, x]``; all blocks
+are padded to the *base* (largest) logical size plus both face radii, and
+smaller blocks keep their data at the same compute offset with a dead tail
+(the pad-and-mask strategy, SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+from ..geometry import Dim3, Radius, raw_size
+
+
+def _axis_sizes(total: int, n: int, base: int) -> Tuple[int, ...]:
+    """Per-index sizes along one axis under the reference remainder rule
+    (partition.hpp:55-70): trailing indices lose one point."""
+    rem = total % n
+    # base = ceil(total / n) when rem != 0, else total / n
+    return tuple(base - (1 if (rem != 0 and i >= rem) else 0) for i in range(n))
+
+
+@dataclass(frozen=True)
+class GridSpec:
+    global_size: Dim3
+    dim: Dim3  # number of blocks along x, y, z
+    radius: Radius
+    base: Dim3 = field(init=False)  # largest block size
+    sizes_x: Tuple[int, ...] = field(init=False)
+    sizes_y: Tuple[int, ...] = field(init=False)
+    sizes_z: Tuple[int, ...] = field(init=False)
+
+    def __post_init__(self):
+        g, d = self.global_size, self.dim
+        assert d.x >= 1 and d.y >= 1 and d.z >= 1
+        assert g.x >= d.x and g.y >= d.y and g.z >= d.z, (
+            f"global {g} too small for partition {d}"
+        )
+        base = Dim3(-(-g.x // d.x), -(-g.y // d.y), -(-g.z // d.z))
+        object.__setattr__(self, "base", base)
+        object.__setattr__(self, "sizes_x", _axis_sizes(g.x, d.x, base.x))
+        object.__setattr__(self, "sizes_y", _axis_sizes(g.y, d.y, base.y))
+        object.__setattr__(self, "sizes_z", _axis_sizes(g.z, d.z, base.z))
+
+    # -- factories ----------------------------------------------------------
+    @classmethod
+    def from_partition(cls, global_size, part, radius: Radius) -> "GridSpec":
+        """From a RankPartition/NodePartition (same remainder semantics)."""
+        return cls(Dim3.of(global_size), part.dim(), radius)
+
+    # -- per-block queries ---------------------------------------------------
+    def block_size(self, idx) -> Dim3:
+        i = Dim3.of(idx)
+        return Dim3(self.sizes_x[i.x], self.sizes_y[i.y], self.sizes_z[i.z])
+
+    def block_origin(self, idx) -> Dim3:
+        i = Dim3.of(idx)
+        return Dim3(
+            sum(self.sizes_x[: i.x]),
+            sum(self.sizes_y[: i.y]),
+            sum(self.sizes_z[: i.z]),
+        )
+
+    def is_uniform(self) -> bool:
+        return self.base * self.dim == self.global_size
+
+    # -- shapes --------------------------------------------------------------
+    def padded(self) -> Dim3:
+        """Per-block allocation extent (x, y, z)."""
+        return raw_size(self.base, self.radius)
+
+    def block_shape_zyx(self) -> Tuple[int, int, int]:
+        p = self.padded()
+        return (p.z, p.y, p.x)
+
+    def stacked_shape_zyx(self) -> Tuple[int, int, int, int, int, int]:
+        """Shape of the stacked-blocks array: (bz, by, bx, pz, py, px)."""
+        p = self.padded()
+        return (self.dim.z, self.dim.y, self.dim.x, p.z, p.y, p.x)
+
+    def num_blocks(self) -> int:
+        return self.dim.flatten()
+
+    def compute_offset(self) -> Dim3:
+        return Dim3(self.radius.x(-1), self.radius.y(-1), self.radius.z(-1))

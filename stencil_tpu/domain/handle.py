@@ -1,0 +1,22 @@
+"""Typed handle naming one quantity within a domain.
+
+TPU-native analogue of the reference's ``DataHandle<T>``
+(reference: include/stencil/local_domain.cuh:18-26). The reference encodes
+the element type in the C++ template parameter and the quantity's slot in an
+integer index; here the handle carries the slot index, a human-readable name,
+and the JAX dtype.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DataHandle:
+    idx: int
+    name: str = ""
+    dtype: str = "float32"
+
+    def __repr__(self) -> str:
+        return f"DataHandle({self.idx}, {self.name!r}, {self.dtype})"

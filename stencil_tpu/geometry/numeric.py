@@ -7,8 +7,6 @@ host-side integer math used at plan time, never traced by JAX.
 
 from __future__ import annotations
 
-import math
-
 
 def prime_factors(n: int) -> list[int]:
     """Prime factorization of ``n``, sorted largest-first.
@@ -48,13 +46,4 @@ def next_power_of_two(x: int) -> int:
 def max_abs_error(a, b) -> float:
     """Largest elementwise absolute difference between two sequences
     (reference: include/stencil/numeric.hpp:27-33)."""
-    return max((abs(x - y) for x, y in zip(a, b)), default=0.0)
-
-
-def trimean_weights(n: int) -> list[float]:
-    # helper kept here to avoid a utils<->geometry cycle; see utils.statistics
-    raise NotImplementedError
-
-
-def isqrt(n: int) -> int:
-    return math.isqrt(n)
+    return max((abs(x - y) for x, y in zip(a, b, strict=True)), default=0.0)

@@ -74,9 +74,18 @@ def compute_offset(radius: Radius) -> Dim3:
 
 
 def halo_rect(direction, size, radius: Radius, halo: bool) -> Rect3:
-    """Allocation-local Rect3 of the halo/exterior region on ``direction``."""
-    pos = halo_pos(direction, size, radius, halo)
-    ext = halo_extent(direction, size, radius)
+    """Allocation-local Rect3 of the halo (``halo=True``) or the matching
+    owned boundary region (``halo=False``) on side ``direction``.
+
+    The owned region adjacent to side ``d`` is what gets *sent* toward
+    ``d``, so it is sized by the receiver's opposite-side halo:
+    ``halo_extent(-d)`` (the reference pairs ``halo_pos(d, false)`` with
+    ``halo_extent(-d)``, src/packer.cu:80-81, test_cuda_local_domain.cu
+    "case1"). With asymmetric per-axis radii the two extents differ.
+    """
+    d = Dim3.of(direction)
+    pos = halo_pos(d, size, radius, halo)
+    ext = halo_extent(d if halo else -d, size, radius)
     return Rect3(pos, pos + ext)
 
 

@@ -1,0 +1,14 @@
+from .mesh import AXIS_X, AXIS_Y, AXIS_Z, MESH_AXES, grid_mesh, mesh_dim
+from .exchange import Method, HaloExchange, direction_bytes
+
+__all__ = [
+    "AXIS_X",
+    "AXIS_Y",
+    "AXIS_Z",
+    "MESH_AXES",
+    "Method",
+    "HaloExchange",
+    "direction_bytes",
+    "grid_mesh",
+    "mesh_dim",
+]

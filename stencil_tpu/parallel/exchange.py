@@ -1,0 +1,312 @@
+"""26-neighbor periodic halo exchange over a TPU device mesh.
+
+This single module replaces the reference's entire transport zoo — the eight
+``Method`` transports, the pack/unpack kernels, the staged/pinned-buffer MPI
+state machines, and the CPU polling engine (reference: include/stencil/
+method.hpp:5-16, tx_cuda.cuh, tx_colocated.cu, src/stencil.cu:1002-1186).
+On TPU all of it collapses into collective permutes compiled by XLA onto the
+ICI torus: ``lax.ppermute`` of boundary slabs inside a ``shard_map``-ped,
+jitted function (SURVEY.md §5.8). "CUDA graph capture" of the exchange
+(packer.cu:96-103) corresponds to the one-time XLA compilation of that jit.
+
+Two exchange strategies are kept (the analogue of the reference's method
+selection, src/stencil.cu:372-412):
+
+- ``Method.AXIS_COMPOSED`` (default): three phases, one per axis, two
+  ``ppermute``s each. Each phase's slabs span the *full padded extent* of
+  the other axes, so edge and corner halos are composed from consecutive
+  phases (x fills faces; y slabs carry x-halo data into xy-edges; z slabs
+  carry both into xz/yz-edges and corners). 6 collectives total,
+  independent of radius shape; supports uneven (remainder) partitions via
+  per-device dynamic slab offsets.
+- ``Method.DIRECT26``: one ``ppermute`` per active direction with exact
+  extents (the literal translation of the reference's 26 messages); uniform
+  partitions only. Useful for verification and collective-count ablation.
+
+Send-extent rule pinned from the reference: the data sent toward direction
+``d`` fills the receiver's ``-d``-side halo, so its extent is
+``halo_extent(-d)`` and a direction is active iff ``radius.dir(-d) != 0``
+(reference: src/stencil.cu:344,358-360, test_cuda_local_domain.cu "case1").
+"""
+
+from __future__ import annotations
+
+import enum
+from functools import cached_property
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..domain.grid import GridSpec
+from ..geometry import DIRECTIONS_26, Dim3, halo_extent
+from .mesh import AXIS_X, AXIS_Y, AXIS_Z, mesh_dim
+
+# (axis name, stacked-array data dim, Dim3 accessor) in exchange-phase order.
+_AXES = (
+    (AXIS_X, 5, "x"),
+    (AXIS_Y, 4, "y"),
+    (AXIS_Z, 3, "z"),
+)
+
+
+class Method(enum.Enum):
+    """Exchange strategy (TPU analogue of method.hpp:5-16)."""
+
+    AXIS_COMPOSED = "axis-composed"
+    DIRECT26 = "direct26"
+
+
+def _spec_axis(spec: GridSpec, name: str):
+    if name == AXIS_X:
+        return spec.sizes_x, spec.radius.x(-1), spec.radius.x(1)
+    if name == AXIS_Y:
+        return spec.sizes_y, spec.radius.y(-1), spec.radius.y(1)
+    return spec.sizes_z, spec.radius.z(-1), spec.radius.z(1)
+
+
+def direction_bytes(spec: GridSpec, direction, itemsize: int) -> int:
+    """Logical bytes received across all blocks for one direction's halos —
+    the accounting the reference Allreduces into per-method counters
+    (reference: src/stencil.cu:139-161,620-627)."""
+    d = Dim3.of(direction)
+    if spec.radius.dir(d) == 0:
+        return 0
+    total = 0
+    for iz in range(spec.dim.z):
+        for iy in range(spec.dim.y):
+            for ix in range(spec.dim.x):
+                ext = halo_extent(d, spec.block_size((ix, iy, iz)), spec.radius)
+                total += ext.flatten() * itemsize
+    return total
+
+
+class HaloExchange:
+    """A compiled halo-exchange over stacked-block arrays.
+
+    State layout: each quantity is an array of shape
+    ``(bz, by, bx, pz, py, px)`` sharded ``P('z','y','x')`` over a grid
+    mesh; ``__call__`` fills every halo cell whose direction is active and
+    returns the updated pytree (donated, so XLA reuses the buffers —
+    the in-place halo write of the reference's unpack kernels).
+    """
+
+    def __init__(self, spec: GridSpec, mesh: Mesh, method: Method = Method.AXIS_COMPOSED):
+        if mesh_dim(mesh) != spec.dim:
+            raise ValueError(f"mesh {dict(mesh.shape)} does not match partition {spec.dim}")
+        if method == Method.DIRECT26 and not spec.is_uniform():
+            raise ValueError("Method.DIRECT26 requires a uniform partition")
+        for name in (AXIS_X, AXIS_Y, AXIS_Z):
+            sizes, rm, rp = _spec_axis(spec, name)
+            if min(sizes) < max(rm, rp):
+                # halos come from the adjacent block only (one neighbor per
+                # direction, like the reference's 26-message plan)
+                raise ValueError(
+                    f"{name}-axis block size {min(sizes)} < radius {max(rm, rp)}: "
+                    "halo would span multiple blocks"
+                )
+        self.spec = spec
+        self.mesh = mesh
+        self.method = method
+
+    # -- public API ----------------------------------------------------------
+    def __call__(self, state):
+        return self._compiled(state)
+
+    @cached_property
+    def _compiled(self):
+        pspec = P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None)
+        body = self._direct26_blocks if self.method == Method.DIRECT26 else self._composed_blocks
+        fn = jax.shard_map(
+            lambda state: jax.tree.map(body, state),
+            mesh=self.mesh,
+            in_specs=pspec,
+            out_specs=pspec,
+        )
+        return jax.jit(fn, donate_argnums=0)
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None))
+
+    def bytes_logical(self, itemsizes: Sequence[int]) -> int:
+        """Total halo bytes delivered per exchange (reference-parity count)."""
+        per_item = sum(
+            direction_bytes(self.spec, d, 1) for d in DIRECTIONS_26
+        )
+        return per_item * sum(itemsizes)
+
+    def bytes_moved(self, itemsizes: Sequence[int]) -> int:
+        """Bytes actually carried by collectives (composed slabs span full
+        padded extents, so this is >= bytes_logical)."""
+        p = self.spec.padded()
+        if self.method == Method.DIRECT26:
+            return self.bytes_logical(itemsizes)
+        per_item = 0
+        r = self.spec.radius
+        per_item += (r.x(-1) + r.x(1)) * p.y * p.z  # x phase
+        per_item += (r.y(-1) + r.y(1)) * p.x * p.z  # y phase
+        per_item += (r.z(-1) + r.z(1)) * p.x * p.y  # z phase
+        return per_item * sum(itemsizes) * self.spec.num_blocks()
+
+    # -- axis-composed implementation ---------------------------------------
+    def _composed_blocks(self, block):
+        for name, adim, _ in _AXES:
+            block = self._axis_phase(block, name, adim)
+        return block
+
+    def _axis_phase(self, block, name: str, adim: int):
+        spec = self.spec
+        sizes, rm, rp = _spec_axis(spec, name)
+        if rm == 0 and rp == 0:
+            return block
+        n = len(sizes)
+        uniform = len(set(sizes)) == 1
+        if uniform:
+            sz = sizes[0]
+        else:
+            sz = jnp.asarray(sizes, dtype=jnp.int32)[lax.axis_index(name)]
+        fwd = [(i, (i + 1) % n) for i in range(n)]
+        bwd = [(i, (i - 1) % n) for i in range(n)]
+        if rm > 0:
+            # my top rm planes -> +neighbor's low-side halo
+            slab = _slice_in_dim(block, sz, rm, adim)
+            slab = lax.ppermute(slab, name, fwd)
+            block = _update_in_dim(block, slab, 0, adim)
+        if rp > 0:
+            # my first rp planes -> -neighbor's high-side halo
+            slab = _slice_in_dim(block, rm, rp, adim)
+            slab = lax.ppermute(slab, name, bwd)
+            block = _update_in_dim(block, slab, rm + sz, adim)
+        return block
+
+    # -- direct-26 implementation -------------------------------------------
+    def _direct26_blocks(self, block):
+        spec = self.spec
+        sz = spec.base  # uniform
+        r = spec.radius
+        rm = spec.compute_offset()
+        updates = []
+        for d in DIRECTIONS_26:
+            if r.dir(-d) == 0:
+                continue
+            starts = []
+            dsts = []
+            shape = []
+            for ax, (dc, s, rmin, rplus, pad) in enumerate(
+                zip(
+                    (d.z, d.y, d.x),
+                    (sz.z, sz.y, sz.x),
+                    (r.z(-1), r.y(-1), r.x(-1)),
+                    (r.z(1), r.y(1), r.x(1)),
+                    spec.block_shape_zyx(),
+                )
+            ):
+                if dc == 1:
+                    starts.append(s)  # last rmin planes of my compute
+                    dsts.append(0)  # receiver's low-side halo
+                    shape.append(rmin)
+                elif dc == -1:
+                    starts.append(rmin)  # first rplus planes of my compute
+                    dsts.append(rmin + s)  # receiver's high-side halo
+                    shape.append(rplus)
+                else:
+                    starts.append(rmin)
+                    dsts.append(rmin)
+                    shape.append(s)
+            if any(e == 0 for e in shape):
+                continue
+            slab = lax.dynamic_slice(
+                block,
+                (0, 0, 0) + tuple(starts),
+                (1, 1, 1) + tuple(shape),
+            )
+            slab = lax.ppermute(slab, (AXIS_Z, AXIS_Y, AXIS_X), self._perm26(d))
+            updates.append((slab, dsts))
+        for slab, dsts in updates:
+            block = lax.dynamic_update_slice(block, slab, (0, 0, 0) + tuple(dsts))
+        return block
+
+    def _perm26(self, d: Dim3) -> Tuple[Tuple[int, int], ...]:
+        """Flattened (z, y, x)-major permutation sending toward ``d``."""
+        nd = self.spec.dim
+        pairs = []
+        for iz in range(nd.z):
+            for iy in range(nd.y):
+                for ix in range(nd.x):
+                    src = (iz * nd.y + iy) * nd.x + ix
+                    jz, jy, jx = (iz + d.z) % nd.z, (iy + d.y) % nd.y, (ix + d.x) % nd.x
+                    dst = (jz * nd.y + jy) * nd.x + jx
+                    pairs.append((src, dst))
+        return tuple(pairs)
+
+
+def _starts(ndim: int, start, adim: int):
+    """Per-dim start indices, uniformly int32 (mixed Python-int / traced-scalar
+    starts trip dynamic_slice's same-dtype requirement under x64)."""
+    s = [jnp.asarray(0, jnp.int32)] * ndim
+    s[adim] = jnp.asarray(start, jnp.int32)
+    return tuple(s)
+
+
+def _slice_in_dim(block, start, width: int, adim: int):
+    """dynamic_slice along one data dim of a (1,1,1,pz,py,px) block."""
+    sizes = list(block.shape)
+    sizes[adim] = width
+    return lax.dynamic_slice(block, _starts(block.ndim, start, adim), tuple(sizes))
+
+
+def _update_in_dim(block, slab, start, adim: int):
+    return lax.dynamic_update_slice(block, slab, _starts(block.ndim, start, adim))
+
+
+# -- host <-> stacked-block conversion ---------------------------------------
+
+def shard_blocks(
+    global_zyx: np.ndarray, spec: GridSpec, mesh: Mesh, dtype=None
+) -> jax.Array:
+    """Scatter a global [z,y,x] host array into the stacked padded layout.
+
+    Halo and pad-tail cells are zero-initialized (garbage until the first
+    exchange, like fresh cudaMalloc in local_domain.cu:159-220).
+    """
+    g = spec.global_size
+    assert global_zyx.shape == (g.z, g.y, g.x), (global_zyx.shape, g)
+    stacked = np.zeros(spec.stacked_shape_zyx(), dtype=dtype or global_zyx.dtype)
+    off = spec.compute_offset()
+    for iz in range(spec.dim.z):
+        for iy in range(spec.dim.y):
+            for ix in range(spec.dim.x):
+                o = spec.block_origin((ix, iy, iz))
+                s = spec.block_size((ix, iy, iz))
+                stacked[
+                    iz, iy, ix,
+                    off.z : off.z + s.z,
+                    off.y : off.y + s.y,
+                    off.x : off.x + s.x,
+                ] = global_zyx[o.z : o.z + s.z, o.y : o.y + s.y, o.x : o.x + s.x]
+    sharding = NamedSharding(mesh, P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None))
+    return jax.device_put(jnp.asarray(stacked), sharding)
+
+
+def unshard_blocks(stacked, spec: GridSpec) -> np.ndarray:
+    """Gather the compute regions of a stacked array back into a global
+    [z,y,x] host array (halos dropped)."""
+    g = spec.global_size
+    arr = np.asarray(jax.device_get(stacked))
+    out = np.empty((g.z, g.y, g.x), dtype=arr.dtype)
+    off = spec.compute_offset()
+    for iz in range(spec.dim.z):
+        for iy in range(spec.dim.y):
+            for ix in range(spec.dim.x):
+                o = spec.block_origin((ix, iy, iz))
+                s = spec.block_size((ix, iy, iz))
+                out[o.z : o.z + s.z, o.y : o.y + s.y, o.x : o.x + s.x] = arr[
+                    iz, iy, ix,
+                    off.z : off.z + s.z,
+                    off.y : off.y + s.y,
+                    off.x : off.x + s.x,
+                ]
+    return out

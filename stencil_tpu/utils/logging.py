@@ -31,14 +31,26 @@ def get_level() -> int:
     return _LEVEL
 
 
-def _prefix(tag: str) -> str:
-    try:
-        import jax
+_PID: int | None = None
 
-        pid = jax.process_index()
-    except Exception:
+
+def _prefix(tag: str) -> str:
+    # Resolve the process index lazily and only if JAX is already imported —
+    # calling jax.process_index() here would otherwise *initialize* the JAX
+    # backend as a side effect of the first log line, pinning the platform
+    # before user code can configure it.
+    global _PID
+    if _PID is None:
         pid = 0
-    return f"[{tag}] p{pid}: "
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                pid = jax.process_index()
+                _PID = pid
+            except Exception:
+                pass  # backend not up yet; retry on a later log line
+        return f"[{tag}] p{pid}: "
+    return f"[{tag}] p{_PID}: "
 
 
 def _emit(level: int, tag: str, msg: str) -> None:

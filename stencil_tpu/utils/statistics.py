@@ -34,8 +34,12 @@ class Statistics:
         return sum(self._v) / len(self._v)
 
     def stddev(self) -> float:
+        """Sample standard deviation (n-1 denominator, matching the
+        reference; NaN for a single sample, bin/statistics.cpp)."""
+        if len(self._v) < 2:
+            return float("nan")
         m = self.avg()
-        return math.sqrt(sum((v - m) ** 2 for v in self._v) / len(self._v))
+        return math.sqrt(sum((v - m) ** 2 for v in self._v) / (len(self._v) - 1))
 
     def _quantile(self, q: float) -> float:
         """Linear-interpolated quantile over the sorted samples."""

@@ -11,7 +11,16 @@ Must set the env vars before JAX initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("JAX_PLATFORMS", None)  # the TPU-tunnel env pins this to its plugin
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# float64 quantities are first-class in the reference (astaroth uses double);
+# the env-var spelling of this flag is ignored once the TPU plugin loads, so
+# set it through the config API.
+jax.config.update("jax_enable_x64", True)

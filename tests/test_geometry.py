@@ -107,3 +107,22 @@ def test_zero_radius_interior_is_compute():
     compute = Rect3.of((0, 0, 0), (5, 5, 5))
     assert interior_region(compute, r) == compute
     assert exterior_regions(compute, compute) == []
+
+
+def test_halo_rect_exterior_asymmetric():
+    """The owned boundary region sent toward +x is sized by the receiver's
+    -x halo (radius.x(-1)), not by radius.x(+1) — regression for the
+    asymmetric-radius send-extent rule (reference: src/packer.cu:80-81)."""
+    from stencil_tpu.geometry import Dim3, Radius, halo_rect
+
+    r = Radius.constant(0)
+    r.set_dir((1, 0, 0), 2)
+    r.set_dir((-1, 0, 0), 1)
+    size = (10, 4, 4)
+    send_px = halo_rect((1, 0, 0), size, r, halo=False)
+    # allocation: [0,1) -x halo, [1,11) compute, [11,13) +x halo
+    assert send_px.lo == Dim3(10, 0, 0)
+    assert send_px.hi == Dim3(11, 4, 4)  # width 1 = radius.x(-1)
+    send_mx = halo_rect((-1, 0, 0), size, r, halo=False)
+    assert send_mx.lo == Dim3(1, 0, 0)
+    assert send_mx.hi == Dim3(3, 4, 4)  # width 2 = radius.x(+1)

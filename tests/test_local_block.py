@@ -1,0 +1,86 @@
+"""LocalBlock tests, behaviors pinned from reference
+test/test_cuda_local_domain.cu (halo extents/positions, curr != next) and
+local_domain.cuh raw_size semantics."""
+
+import numpy as np
+import pytest
+
+from stencil_tpu.domain import LocalBlock, block_rect_slices
+from stencil_tpu.geometry import Dim3, Radius, Rect3
+
+
+def asym_radius():
+    r = Radius.constant(0)
+    r.set_dir((1, 0, 0), 2)
+    r.set_dir((-1, 0, 0), 1)
+    return r
+
+
+class TestGeometryQueries:
+    def test_asymmetric_send_extent(self):
+        # reference case1: +x send is sized like the -x side halo
+        b = LocalBlock((3, 4, 5), (0, 0, 0), asym_radius())
+        ext = b.halo_region(Dim3(-1, 0, 0), halo=True).extent()
+        assert ext == Dim3(1, 4, 5)
+
+    def test_raw_size(self):
+        b = LocalBlock((3, 4, 5), (0, 0, 0), asym_radius())
+        assert b.raw_size() == Dim3(3 + 1 + 2, 4, 5)
+
+    def test_symmetric_face_positions(self):
+        b = LocalBlock((30, 40, 50), (0, 0, 0), Radius.constant(4))
+        assert b.halo_region((-1, 0, 0), True).lo == Dim3(0, 4, 4)
+        assert b.halo_region((1, 0, 0), True).lo == Dim3(34, 4, 4)
+        assert b.halo_region((0, 1, 0), True).lo == Dim3(4, 44, 4)
+        assert b.halo_region((-1, 0, 0), False).lo == Dim3(4, 4, 4)
+        assert b.halo_region((1, 0, 0), False).lo == Dim3(30, 4, 4)
+        assert b.halo_region((-1, 0, 0), True).extent() == Dim3(4, 40, 50)
+        assert b.halo_region((0, -1, 0), True).extent() == Dim3(30, 4, 50)
+
+
+class TestData:
+    def test_curr_neq_next(self):
+        b = LocalBlock((3, 4, 5), (0, 0, 0), asym_radius())
+        h = b.add_data("q", "float32")
+        b.realize()
+        c = b.get_curr(h)
+        n = b.get_next(h)
+        assert c.shape == (5, 4, 6)  # [z, y, x]
+        c2 = c.at[0, 0, 0].set(1.0)
+        b.set_curr(h, c2)
+        assert float(b.get_curr(h)[0, 0, 0]) == 1.0
+        assert float(b.get_next(h)[0, 0, 0]) == 0.0
+        assert n is not c2
+
+    def test_swap(self):
+        b = LocalBlock((4, 4, 4), (0, 0, 0), Radius.constant(1))
+        h = b.add_data()
+        b.realize()
+        b.set_next(h, b.get_next(h) + 7.0)
+        b.swap()
+        assert float(b.get_curr(h)[0, 0, 0]) == 7.0
+        assert float(b.get_next(h)[0, 0, 0]) == 0.0
+
+    def test_region_to_host(self):
+        b = LocalBlock((4, 4, 4), (0, 0, 0), Radius.constant(1))
+        h = b.add_data()
+        b.realize()
+        arr = np.arange(6 * 6 * 6, dtype=np.float32).reshape(6, 6, 6)
+        import jax.numpy as jnp
+
+        b.set_curr(h, jnp.asarray(arr))
+        rect = Rect3(Dim3(1, 1, 1), Dim3(5, 5, 5))
+        got = b.region_to_host(h, rect)
+        np.testing.assert_array_equal(got, arr[1:5, 1:5, 1:5])
+        np.testing.assert_array_equal(b.interior_to_host(h), arr[1:5, 1:5, 1:5])
+
+    def test_multi_dtype(self):
+        b = LocalBlock((4, 4, 4), (0, 0, 0), Radius.constant(1))
+        hf = b.add_data("f", "float32")
+        hd = b.add_data("d", "float64")
+        hi = b.add_data("i", "int32")
+        b.realize()
+        assert b.get_curr(hf).dtype == np.float32
+        assert b.get_curr(hd).dtype == np.float64
+        assert b.get_curr(hi).dtype == np.int32
+        assert b.num_data() == 3
