@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Headline benchmark: jacobi3d Mcells/s/chip at 512^3 (reference default
+size, bin/jacobi3d.cu:100-102) plus halo-exchange GB/s, printed as ONE JSON
+line. Runs on whatever accelerator JAX finds (the driver provides one TPU
+chip); falls back to a small CPU run if only CPU is available.
+
+vs_baseline compares against this repo's recorded round-1 TPU numbers in
+BASELINE.md (the reference publishes no absolute numbers — BASELINE.md §1).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# Round-1 recorded TPU v5e-chip numbers (see BASELINE.md "Recorded numbers").
+BASELINE_MCELLS_PER_S_PER_CHIP = 3394.8
+BASELINE_EXCHANGE_GB_S = 2.18
+
+
+def main() -> int:
+    import jax
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    n = 512 if on_accel else 128
+    iters = 10 if on_accel else 3
+
+    from stencil_tpu.apps.jacobi3d import run
+    from stencil_tpu.utils.statistics import Statistics
+    from stencil_tpu.utils.sync import hard_sync
+
+    r = run(n, n, n, iters=3 * iters, weak=False, devices=jax.devices()[:1],
+            warmup=1, chunk=iters)
+    mcells = r["mcells_per_s_per_dev"]
+
+    # exchange benchmark: radius-3, 4 float quantities (exchange_weak config,
+    # bin/exchange_weak.cu:49-51,143), fused loop of `iters` exchanges
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks
+    import numpy as np
+
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
+    mesh = grid_mesh(spec.dim, jax.devices()[:1])
+    ex = HaloExchange(spec, mesh)
+    loop = ex.make_loop(iters)
+    state = {
+        i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh) for i in range(4)
+    }
+    state = loop(state)  # compile + warm
+    hard_sync(state)
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state = loop(state)
+        hard_sync(state)
+        st.insert((time.perf_counter() - t0) / iters)
+    ex_gb_s = ex.bytes_logical([4] * 4) / st.trimean() / 1e9
+
+    value = round(mcells, 1)
+    # the recorded baseline is a 512^3 TPU number; a CPU fallback run gets its
+    # own metric name and no baseline ratio so the two are never conflated
+    comparable = on_accel and n == 512
+    vs = value / BASELINE_MCELLS_PER_S_PER_CHIP if comparable else 0.0
+    metric = (
+        "jacobi3d_512_mcells_per_s_per_chip"
+        if comparable
+        else f"jacobi3d_{n}_mcells_per_s_per_chip_cpu_fallback"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": value,
+                "unit": "Mcells/s",
+                "vs_baseline": round(vs, 3),
+                "detail": {
+                    "iter_trimean_s": round(r["iter_trimean_s"], 6),
+                    "exchange_gb_per_s_r3_4q": round(ex_gb_s, 2),
+                    "exchange_vs_baseline": (
+                        round(ex_gb_s / BASELINE_EXCHANGE_GB_S, 3) if comparable else 0.0
+                    ),
+                    "platform": jax.devices()[0].platform,
+                    "size": n,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,303 @@
+"""DistributedDomain — the top-level user API.
+
+TPU-native re-design of the reference orchestrator
+(reference: include/stencil/stencil.hpp:33-225, src/stencil.cu). The surface
+is kept: ``set_radius`` → ``add_data`` → ``realize`` → loop
+{compute / ``exchange`` / ``swap``} → ``write_paraview``. What changed
+underneath:
+
+- Subdomain-per-GPU ``LocalDomain`` allocations become one stacked,
+  halo-padded array per quantity, sharded ``P('z','y','x')`` over a 3D
+  device mesh (all blocks of all "ranks" in one jit-visible value).
+- ``realize``'s transport planning (the 26-direction goto-cascade,
+  src/stencil.cu:327-464, and sender/recver construction :651-759) becomes
+  the construction + compilation of one :class:`HaloExchange`.
+- ``exchange``'s CPU polling engine (src/stencil.cu:1002-1186) is one call
+  into the compiled collective program; overlap is XLA's job (SURVEY §7.5).
+- Placement (``do_placement``, src/stencil.cu:201-239) becomes device-mesh
+  layout; the partition is still the comm-minimizing NodePartition.
+
+Setup/exchange statistics mirror STENCIL_SETUP_STATS / STENCIL_EXCHANGE_STATS
+(reference: CMakeLists.txt:17-22) but are always on — they cost one host
+timestamp per call.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .domain import DataHandle, GridSpec
+from .geometry import (
+    DIRECTIONS_26,
+    Dim3,
+    NodePartition,
+    Radius,
+    Rect3,
+    exterior_regions,
+    halo_extent,
+    interior_region,
+)
+from .parallel import HaloExchange, Method, grid_mesh
+from .parallel.exchange import direction_bytes, shard_blocks, unshard_blocks
+from .utils import logging as log
+from .utils.sync import hard_sync
+
+
+class DistributedDomain:
+    """A multi-quantity 3D domain distributed over a TPU device mesh."""
+
+    def __init__(self, x: int, y: int, z: int):
+        self.size = Dim3(x, y, z)
+        self.radius = Radius.constant(0)
+        self._names: List[str] = []
+        self._dtypes: List[str] = []
+        self._method = Method.AXIS_COMPOSED
+        self._devices: Optional[Sequence] = None
+        self._partition_dim: Optional[Dim3] = None
+        self._placement = None
+        self._output_prefix = os.environ.get("STENCIL_OUTPUT_PREFIX", "")
+        self._realized = False
+        # data (after realize): handle.idx -> stacked array
+        self._curr: Dict[int, jax.Array] = {}
+        self._next: Dict[int, jax.Array] = {}
+        # setup stats (reference: stencil.hpp:103-112)
+        self.time_plan = 0.0
+        self.time_realize = 0.0
+        self.time_create = 0.0
+        # exchange stats (reference: stencil.hpp:96-101)
+        self.time_exchange = 0.0
+        self.time_swap = 0.0
+        self.num_exchanges = 0
+
+    # -- configuration (pre-realize) ----------------------------------------
+    def set_radius(self, r) -> None:
+        """Uniform or per-direction radius (reference: stencil.hpp:124-137)."""
+        self.radius = Radius.constant(r) if isinstance(r, int) else r
+
+    def add_data(self, name: str = "", dtype="float32") -> DataHandle:
+        """Register a quantity (reference: stencil.hpp:128)."""
+        assert not self._realized
+        idx = len(self._names)
+        self._names.append(name or f"data{idx}")
+        self._dtypes.append(str(jnp.dtype(dtype)))
+        return DataHandle(idx, self._names[-1], self._dtypes[-1])
+
+    def set_methods(self, method: Method) -> None:
+        """Exchange strategy (reference: stencil.hpp:139)."""
+        self._method = method
+
+    def set_devices(self, devices: Sequence) -> None:
+        """Restrict to specific devices (reference ``set_gpus``,
+        stencil.hpp:154)."""
+        self._devices = list(devices)
+
+    def set_placement(self, placement) -> None:
+        """Device-placement strategy (reference: stencil.hpp:146)."""
+        self._placement = placement
+
+    def set_partition(self, dim) -> None:
+        """Override the automatic partition grid (testing/ablation)."""
+        self._partition_dim = Dim3.of(dim)
+
+    def set_output_prefix(self, prefix: str) -> None:
+        self._output_prefix = prefix
+
+    # -- realize -------------------------------------------------------------
+    def realize(self) -> None:
+        """Partition, build the mesh, allocate quantities, compile exchange
+        (reference: src/stencil.cu:241-850)."""
+        t0 = time.perf_counter()
+        devices = list(self._devices) if self._devices is not None else jax.devices()
+        n = len(devices)
+        if self._partition_dim is not None:
+            dim = self._partition_dim
+        else:
+            # comm-minimizing two-level split: hosts x devices-per-host
+            # (reference: do_placement -> NodeAware, src/stencil.cu:201-239)
+            hosts = max(1, jax.process_count())
+            part = NodePartition(self.size, self.radius, hosts, max(1, n // hosts))
+            dim = part.dim()
+        if dim.flatten() != n:
+            raise ValueError(f"partition {dim} needs {dim.flatten()} devices, have {n}")
+        self.spec = GridSpec(self.size, dim, self.radius)
+        if self._placement is not None:
+            devices = self._placement.arrange(devices, self.spec)
+        self.mesh = grid_mesh(dim, devices)
+        self.time_plan = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        shape = self.spec.stacked_shape_zyx()
+        self._exchange = HaloExchange(self.spec, self.mesh, self._method)
+        sharding = self._exchange.sharding()
+        for idx, dt in enumerate(self._dtypes):
+            self._curr[idx] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
+            self._next[idx] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
+        self.time_realize = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        self._exchange._compiled  # build + trace now, like the two-phase prepare
+        self.time_create = time.perf_counter() - t0
+        self._realized = True
+        log.debug(
+            f"realized {self.size} over {dim} blocks of {self.spec.base}, "
+            f"padded {self.spec.padded()}"
+        )
+        if self._output_prefix:
+            self.write_plan(self._output_prefix)
+
+    # -- data access ---------------------------------------------------------
+    def get_curr(self, h: DataHandle) -> jax.Array:
+        return self._curr[h.idx]
+
+    def get_next(self, h: DataHandle) -> jax.Array:
+        return self._next[h.idx]
+
+    def set_curr(self, h: DataHandle, stacked: jax.Array) -> None:
+        self._curr[h.idx] = stacked
+
+    def set_next(self, h: DataHandle, stacked: jax.Array) -> None:
+        self._next[h.idx] = stacked
+
+    def curr_state(self) -> Dict[int, jax.Array]:
+        return dict(self._curr)
+
+    def next_state(self) -> Dict[int, jax.Array]:
+        return dict(self._next)
+
+    def set_curr_global(self, h: DataHandle, global_zyx: np.ndarray) -> None:
+        """Scatter a host array [z,y,x] into the sharded layout."""
+        self._curr[h.idx] = shard_blocks(
+            global_zyx.astype(self._dtypes[h.idx]), self.spec, self.mesh
+        )
+
+    def get_curr_global(self, h: DataHandle) -> np.ndarray:
+        """Gather the compute region to a host array [z,y,x]."""
+        return unshard_blocks(self._curr[h.idx], self.spec)
+
+    def sharding(self):
+        return self._exchange.sharding()
+
+    # -- the iteration API (reference: stencil.hpp:182-215) ------------------
+    def exchange(self) -> None:
+        """Fill every halo from the periodic neighbors
+        (reference: src/stencil.cu:1002-1186)."""
+        t0 = time.perf_counter()
+        self._curr = self._exchange(self._curr)
+        hard_sync(self._curr)  # block_until_ready lies on the tunneled TPU
+        self.time_exchange += time.perf_counter() - t0
+        self.num_exchanges += 1
+
+    def swap(self) -> None:
+        """Swap curr/next (reference: src/stencil.cu:852-872)."""
+        t0 = time.perf_counter()
+        self._curr, self._next = self._next, self._curr
+        self.time_swap += time.perf_counter() - t0
+
+    def get_interior(self) -> List[Rect3]:
+        """Per-block interior compute region, allocation-local coordinates
+        (reference: src/stencil.cu:878-921)."""
+        out = []
+        off = self.spec.compute_offset()
+        for i in range(self.spec.num_blocks()):
+            idx = self._block_idx(i)
+            sz = self.spec.block_size(idx)
+            compute = Rect3(off, off + sz)
+            out.append(interior_region(compute, self.radius))
+        return out
+
+    def get_exterior(self) -> List[List[Rect3]]:
+        """Per-block exterior slabs (reference: src/stencil.cu:927-977)."""
+        out = []
+        off = self.spec.compute_offset()
+        interiors = self.get_interior()
+        for i in range(self.spec.num_blocks()):
+            idx = self._block_idx(i)
+            sz = self.spec.block_size(idx)
+            compute = Rect3(off, off + sz)
+            out.append(exterior_regions(compute, interiors[i]))
+        return out
+
+    def _block_idx(self, i: int) -> Dim3:
+        d = self.spec.dim
+        return Dim3(i % d.x, (i // d.x) % d.y, i // (d.x * d.y))
+
+    # -- accounting (reference: src/stencil.cu:139-161) ----------------------
+    def exchange_bytes_for_method(self, method: Method) -> int:
+        """Logical halo bytes per exchange attributed to ``method``."""
+        if method != self._method:
+            return 0
+        itemsizes = [jnp.dtype(dt).itemsize for dt in self._dtypes]
+        return self._exchange.bytes_logical(itemsizes)
+
+    def exchange_bytes_moved(self) -> int:
+        itemsizes = [jnp.dtype(dt).itemsize for dt in self._dtypes]
+        return self._exchange.bytes_moved(itemsizes)
+
+    # -- observability -------------------------------------------------------
+    def write_plan(self, prefix: str) -> None:
+        """Dump the exchange plan and the block-comm matrix — the analogue of
+        plan_<rank>.txt / mat_npy_loadtxt.txt (reference:
+        src/stencil.cu:482-637)."""
+        path = f"{prefix}plan_{jax.process_index()}.txt"
+        with open(path, "w") as f:
+            f.write(f"global {self.size} dim {self.spec.dim} base {self.spec.base}\n")
+            f.write(f"radius {self.radius}\n")
+            f.write(f"method {self._method.value}\n")
+            f.write(f"mesh {dict(self.mesh.shape)}\n")
+            itemsizes = [jnp.dtype(dt).itemsize for dt in self._dtypes]
+            for d in DIRECTIONS_26:
+                b = direction_bytes(self.spec, d, sum(itemsizes))
+                f.write(f"dir ({d.x},{d.y},{d.z}) bytes {b}\n")
+        # block-to-block byte matrix for numpy loadtxt
+        nb = self.spec.num_blocks()
+        mat = np.zeros((nb, nb), dtype=np.int64)
+        itemsize = sum(jnp.dtype(dt).itemsize for dt in self._dtypes)
+        for i in range(nb):
+            src = self._block_idx(i)
+            for d in DIRECTIONS_26:
+                if self.radius.dir(d) == 0:
+                    continue
+                dst = (src + d).wrap(self.spec.dim)
+                j = dst.x + dst.y * self.spec.dim.x + dst.z * self.spec.dim.x * self.spec.dim.y
+                ext = halo_extent(d, self.spec.block_size(src), self.radius)
+                mat[i, j] += ext.flatten() * itemsize
+        np.savetxt(f"{prefix}mat_npy_loadtxt.txt", mat, fmt="%d")
+
+    def write_paraview(self, prefix: str, zero_nans: bool = False) -> None:
+        """Per-block CSV dump of the interior — same columns as the reference
+        (Z,Y,X,<quantity names>; reference: src/stencil.cu:1188-1264)."""
+        off = self.spec.compute_offset()
+        hosts = {
+            idx: np.asarray(jax.device_get(arr)) for idx, arr in self._curr.items()
+        }
+        for i in range(self.spec.num_blocks()):
+            idx3 = self._block_idx(i)
+            sz = self.spec.block_size(idx3)
+            origin = self.spec.block_origin(idx3)
+            path = f"{prefix}_{i}.txt"
+            with open(path, "w") as f:
+                cols = ["Z", "Y", "X"] + list(self._names)
+                f.write(",".join(cols) + "\n")
+                qs = []
+                for qi in range(len(self._names)):
+                    block = hosts[qi][idx3.z, idx3.y, idx3.x]
+                    q = block[
+                        off.z : off.z + sz.z, off.y : off.y + sz.y, off.x : off.x + sz.x
+                    ]
+                    if zero_nans:
+                        q = np.nan_to_num(q, nan=0.0)
+                    qs.append(q)
+                for lz in range(sz.z):
+                    for ly in range(sz.y):
+                        for lx in range(sz.x):
+                            pos = origin + Dim3(lx, ly, lz)
+                            row = [str(pos.z), str(pos.y), str(pos.x)]
+                            row += [repr(float(q[lz, ly, lx])) for q in qs]
+                            f.write(",".join(row) + "\n")
+            log.info(f"wrote paraview file {path}")

@@ -1,0 +1,3 @@
+"""CLI applications — the TPU-native counterparts of the reference's
+``bin/`` executables (reference: bin/CMakeLists.txt:99-151). Each app
+prints one CSV result row matching the reference's format."""

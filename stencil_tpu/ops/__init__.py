@@ -1,0 +1,17 @@
+from .jacobi import (
+    jacobi6_block,
+    jacobi_reference,
+    jacobi_sweep,
+    make_jacobi_loop,
+    make_jacobi_step,
+    sphere_masks,
+)
+
+__all__ = [
+    "jacobi6_block",
+    "jacobi_reference",
+    "jacobi_sweep",
+    "make_jacobi_loop",
+    "make_jacobi_step",
+    "sphere_masks",
+]

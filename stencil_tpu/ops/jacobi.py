@@ -1,0 +1,184 @@
+"""7-point Jacobi heat-diffusion stencil and its fused distributed step.
+
+TPU-native re-design of the reference demo kernel and iteration structure
+(reference: bin/jacobi3d.cu:30-85 kernel, :296-377 overlap loop): each
+compute cell becomes the average of its six face neighbors; a "hot" sphere
+(value 1) fixed at x = 1/3 and a "cold" sphere (value 0) at x = 2/3 of the
+global domain, radius X/10, are re-imposed every step. Initial condition is
+0.5 everywhere (bin/jacobi3d.cu:25).
+
+The kernel is shifted array slices over the halo-padded block — XLA fuses
+the adds, divide, and sphere masks into one elementwise pass (the analogue
+of the reference's single CUDA kernel). The comm/compute overlap of the
+reference (interior kernel on its own stream, CPU-polled exchange, then
+exterior kernels, src/stencil.cu:1002-1186) becomes *dataflow*: inside one
+jitted step the interior sweep depends only on pre-exchange data, so XLA is
+free to run the halo ``ppermute``s concurrently with it, then the exterior
+slabs consume the exchanged halos. No host polling exists.
+
+Sphere masks are precomputed host-side from global coordinates and sharded
+alongside the quantity (step-invariant).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..geometry import Dim3, Radius, Rect3, exterior_regions, interior_region
+from ..parallel.exchange import BLOCK_PSPEC, HaloExchange
+
+HOT_TEMP = 1.0
+COLD_TEMP = 0.0
+INIT_TEMP = (HOT_TEMP + COLD_TEMP) / 2
+
+
+def _rect_slices(rect: Rect3, dz=0, dy=0, dx=0):
+    return (
+        slice(rect.lo.z + dz, rect.hi.z + dz),
+        slice(rect.lo.y + dy, rect.hi.y + dy),
+        slice(rect.lo.x + dx, rect.hi.x + dx),
+    )
+
+
+def jacobi_sweep(src, out, rect: Rect3, masks=None):
+    """Write the 6-neighbor average of ``src`` into region ``rect`` of
+    ``out`` (allocation-local coords; leading dims allowed). ``masks`` is an
+    optional ``(hot, cold)`` pair of bool arrays shaped like ``src``."""
+    avg = (
+        src[(..., *_rect_slices(rect, dx=-1))]
+        + src[(..., *_rect_slices(rect, dx=1))]
+        + src[(..., *_rect_slices(rect, dy=-1))]
+        + src[(..., *_rect_slices(rect, dy=1))]
+        + src[(..., *_rect_slices(rect, dz=-1))]
+        + src[(..., *_rect_slices(rect, dz=1))]
+    ) / 6
+    if masks is not None:
+        hot, cold = masks
+        sl = (..., *_rect_slices(rect))
+        avg = jnp.where(hot[sl], HOT_TEMP, jnp.where(cold[sl], COLD_TEMP, avg))
+    return out.at[(..., *_rect_slices(rect))].set(avg.astype(out.dtype))
+
+
+def jacobi6_block(block, radius: Radius, masks=None):
+    """One full-compute-region Jacobi sweep over a padded block, in place of
+    the halo ring (reference kernel over the whole region,
+    bin/jacobi3d.cu:343-360)."""
+    assert min(
+        radius.x(-1), radius.x(1), radius.y(-1), radius.y(1), radius.z(-1), radius.z(1)
+    ) >= 1, "jacobi needs face radius >= 1"
+    *_, pz, py, px = block.shape
+    off = Dim3(radius.x(-1), radius.y(-1), radius.z(-1))
+    hi = Dim3(px - radius.x(1), py - radius.y(1), pz - radius.z(1))
+    return jacobi_sweep(block, block, Rect3(off, hi), masks)
+
+
+def make_jacobi_step(ex: HaloExchange, overlap: bool = True):
+    """Build the jitted distributed iteration: exchange + stencil + swap.
+
+    Returns ``step(curr, nxt, hot, cold) -> (new_curr, new_next)`` over
+    stacked sharded arrays; buffers are donated (the double-buffer swap of
+    the reference, src/local_domain.cu:67-84, as input/output aliasing).
+
+    ``overlap=True`` replicates the reference's interior/exterior split
+    (bin/jacobi3d.cu:296-368): the interior sweep reads pre-exchange data
+    (it never touches halos, src/stencil.cu:878-921), the ≤6 exterior slabs
+    read exchanged halos. On an uneven partition the step falls back to
+    exchange-then-full-sweep (slab extents would be data-dependent).
+    """
+    return _compile_jacobi(ex, overlap, iters=None)
+
+
+def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True):
+    """Like :func:`make_jacobi_step` but runs ``iters`` iterations inside one
+    compiled program (``lax.fori_loop``) — one host dispatch per chunk.
+
+    This is the ``USE_CUDA_GRAPH`` analogue taken further: where the
+    reference graph-captures one exchange (packer.cu:96-103), XLA compiles
+    the whole iteration loop, which also removes the per-call host
+    round-trip of the tunneled TPU platform (~0.7 s each).
+    """
+    return _compile_jacobi(ex, overlap, iters=iters)
+
+
+def _compile_jacobi(ex: HaloExchange, overlap: bool, iters):
+    spec = ex.spec
+    r = spec.radius
+    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
+        "jacobi needs face radius >= 1 on every side"
+    )
+    off = spec.compute_offset()
+    compute = Rect3(off, off + spec.base)
+    interior = interior_region(compute, r)
+    exteriors = exterior_regions(compute, interior)
+    use_overlap = overlap and spec.is_uniform()
+
+    def body(curr, nxt, masks):
+        if use_overlap:
+            out = jacobi_sweep(curr, nxt, interior, masks)
+            cur2 = ex.exchange_block(curr)
+            for rect in exteriors:
+                out = jacobi_sweep(cur2, out, rect, masks)
+        else:
+            cur2 = ex.exchange_block(curr)
+            out = jacobi_sweep(cur2, nxt, compute, masks)
+        # swap: computed buffer becomes curr, old curr becomes scratch
+        return out, cur2
+
+    def entry_fn(curr, nxt, hot, cold):
+        if iters is None:
+            return body(curr, nxt, (hot, cold))
+        return jax.lax.fori_loop(
+            0, iters, lambda _, cn: body(cn[0], cn[1], (hot, cold)), (curr, nxt)
+        )
+
+    fn = jax.shard_map(
+        entry_fn,
+        mesh=ex.mesh,
+        in_specs=(BLOCK_PSPEC,) * 4,
+        out_specs=(BLOCK_PSPEC, BLOCK_PSPEC),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def sphere_masks(global_size) -> Tuple[np.ndarray, np.ndarray]:
+    """Hot/cold sphere masks over the global [z,y,x] grid.
+
+    Bit-parity with the reference's integer-truncated distance
+    (bin/jacobi3d.cu:30-32,49): dist = int64(sqrtf(dx^2+dy^2+dz^2)),
+    hot iff dist(hotCenter) <= X/10."""
+    g = Dim3.of(global_size)
+    hot_c = (g.x // 3, g.y // 2, g.z // 2)
+    cold_c = (g.x * 2 // 3, g.y // 2, g.z // 2)
+    rad = g.x // 10
+    # sparse (broadcastable) coordinate axes: only the final dense d2 array
+    # is full-size, not three int64 coordinate cubes
+    z, y, x = np.meshgrid(
+        np.arange(g.z), np.arange(g.y), np.arange(g.x), indexing="ij", sparse=True
+    )
+
+    def dist(c):
+        d2 = (x - c[0]) ** 2 + (y - c[1]) ** 2 + (z - c[2]) ** 2
+        return np.sqrt(d2.astype(np.float32)).astype(np.int64)
+
+    hot = dist(hot_c) <= rad
+    cold = (~hot) & (dist(cold_c) <= rad)
+    return hot, cold
+
+
+def jacobi_reference(field: np.ndarray, masks, iters: int) -> np.ndarray:
+    """Slow numpy reference with periodic wrap for correctness checks
+    (the CPU reference of BASELINE.json config 1)."""
+    hot, cold = masks
+    f = field.astype(np.float64)
+    for _ in range(iters):
+        avg = (
+            np.roll(f, 1, 2) + np.roll(f, -1, 2)
+            + np.roll(f, 1, 1) + np.roll(f, -1, 1)
+            + np.roll(f, 1, 0) + np.roll(f, -1, 0)
+        ) / 6
+        f = np.where(hot, HOT_TEMP, np.where(cold, COLD_TEMP, avg))
+    return f

@@ -52,6 +52,10 @@ _AXES = (
     (AXIS_Z, 3, "z"),
 )
 
+# The one PartitionSpec of the stacked-block layout (bz, by, bx, pz, py, px):
+# block-grid dims sharded over the mesh, data dims replicated.
+BLOCK_PSPEC = P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None)
+
 
 class Method(enum.Enum):
     """Exchange strategy (TPU analogue of method.hpp:5-16)."""
@@ -116,20 +120,37 @@ class HaloExchange:
     def __call__(self, state):
         return self._compiled(state)
 
+    def exchange_block(self, block):
+        """Per-block exchange body for composing into larger shard_map'd
+        steps (e.g. fused compute/exchange overlap): takes and returns one
+        (1,1,1,pz,py,px) block inside a ``shard_map`` over this mesh."""
+        body = self._direct26_blocks if self.method == Method.DIRECT26 else self._composed_blocks
+        return body(block)
+
     @cached_property
     def _compiled(self):
-        pspec = P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None)
-        body = self._direct26_blocks if self.method == Method.DIRECT26 else self._composed_blocks
         fn = jax.shard_map(
-            lambda state: jax.tree.map(body, state),
+            lambda state: jax.tree.map(self.exchange_block, state),
             mesh=self.mesh,
-            in_specs=pspec,
-            out_specs=pspec,
+            in_specs=BLOCK_PSPEC,
+            out_specs=BLOCK_PSPEC,
         )
         return jax.jit(fn, donate_argnums=0)
 
     def sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None))
+        return NamedSharding(self.mesh, BLOCK_PSPEC)
+
+    def make_loop(self, iters: int):
+        """``iters`` back-to-back exchanges in one compiled program — for
+        benchmarking without per-dispatch host overhead (the analogue of the
+        reference's timed exchange loop, bin/exchange_weak.cu:168-177)."""
+        def many(state):
+            return lax.fori_loop(
+                0, iters, lambda _, s: jax.tree.map(self.exchange_block, s), state
+            )
+
+        fn = jax.shard_map(many, mesh=self.mesh, in_specs=BLOCK_PSPEC, out_specs=BLOCK_PSPEC)
+        return jax.jit(fn, donate_argnums=0)
 
     def bytes_logical(self, itemsizes: Sequence[int]) -> int:
         """Total halo bytes delivered per exchange (reference-parity count)."""
@@ -287,8 +308,7 @@ def shard_blocks(
                     off.y : off.y + s.y,
                     off.x : off.x + s.x,
                 ] = global_zyx[o.z : o.z + s.z, o.y : o.y + s.y, o.x : o.x + s.x]
-    sharding = NamedSharding(mesh, P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None))
-    return jax.device_put(jnp.asarray(stacked), sharding)
+    return jax.device_put(jnp.asarray(stacked), NamedSharding(mesh, BLOCK_PSPEC))
 
 
 def unshard_blocks(stacked, spec: GridSpec) -> np.ndarray:

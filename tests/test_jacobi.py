@@ -1,0 +1,84 @@
+"""jacobi3d correctness: distributed overlap step vs numpy periodic
+reference (BASELINE.json config 1 idiom: vs CPU reference)."""
+
+import jax
+import numpy as np
+import pytest
+
+from stencil_tpu.apps.jacobi3d import run, weak_scale, csv_row
+from stencil_tpu.geometry import Dim3
+from stencil_tpu.ops.jacobi import INIT_TEMP, jacobi_reference, sphere_masks
+from stencil_tpu.parallel import Method
+
+
+def test_weak_scale_matches_reference_rule():
+    # prime factors of 8 = [2,2,2] multiplied into smallest axis each time
+    assert weak_scale(4, 4, 4, 8) == Dim3(8, 8, 8)
+    assert weak_scale(2, 3, 5, 6) == Dim3(6, 6, 5)  # pf [3,2]: x*3=6 then y*2=6
+    assert weak_scale(5, 5, 5, 1) == Dim3(5, 5, 5)
+
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_jacobi_matches_numpy(overlap):
+    iters = 4
+    r = run(20, 16, 12, iters=iters, overlap=overlap, weak=False,
+            devices=jax.devices()[:8], warmup=0)
+    size = Dim3(r["x"], r["y"], r["z"])
+    dd, h = r["domain"], r["handle"]
+    got = dd.get_curr_global(h)
+
+    masks = sphere_masks(size)
+    field = np.full((size.z, size.y, size.x), INIT_TEMP, dtype=np.float32)
+    want = jacobi_reference(field, masks, iters)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_overlap_equals_no_overlap():
+    ra = run(20, 16, 12, iters=3, overlap=True, weak=False,
+             devices=jax.devices()[:8], warmup=0)
+    rb = run(20, 16, 12, iters=3, overlap=False, weak=False,
+             devices=jax.devices()[:8], warmup=0)
+    a = ra["domain"].get_curr_global(ra["handle"])
+    b = rb["domain"].get_curr_global(rb["handle"])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_direct26_method_agrees():
+    ra = run(16, 16, 16, iters=2, weak=False, devices=jax.devices()[:8], warmup=0)
+    rb = run(16, 16, 16, iters=2, weak=False, devices=jax.devices()[:8],
+             method=Method.DIRECT26, warmup=0)
+    a = ra["domain"].get_curr_global(ra["handle"])
+    b = rb["domain"].get_curr_global(rb["handle"])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_uneven_distributed_jacobi():
+    """Uneven partition falls back to non-overlap but must stay correct."""
+    iters = 3
+    r = run(18, 14, 10, iters=iters, weak=False, devices=jax.devices()[:8], warmup=0)
+    size = Dim3(r["x"], r["y"], r["z"])
+    masks = sphere_masks(size)
+    field = np.full((size.z, size.y, size.x), INIT_TEMP, dtype=np.float32)
+    want = jacobi_reference(field, masks, iters)
+    got = r["domain"].get_curr_global(r["handle"])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_csv_row_format():
+    r = run(8, 8, 8, iters=1, weak=False, devices=jax.devices()[:1], warmup=0)
+    row = csv_row(r)
+    assert row.startswith("jacobi3d,axis-composed,1,1,8,8,8,")
+    assert len(row.split(",")) == 10
+
+
+def test_run_executes_exact_iteration_count():
+    """iters not a multiple of the fused chunk must not overshoot."""
+    iters = 7
+    r = run(16, 12, 10, iters=iters, weak=False, devices=jax.devices()[:8],
+            warmup=0, chunk=5)
+    size = Dim3(r["x"], r["y"], r["z"])
+    masks = sphere_masks(size)
+    field = np.full((size.z, size.y, size.x), INIT_TEMP, dtype=np.float32)
+    want = jacobi_reference(field, masks, iters)
+    got = r["domain"].get_curr_global(r["handle"])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
