@@ -128,7 +128,7 @@ class DistributedDomain:
         self.spec = GridSpec(self.size, dim, self.radius)
         if self._placement is not None:
             devices = self._placement.arrange(devices, self.spec)
-        self.mesh = grid_mesh(dim, devices)
+        self.mesh = grid_mesh(dim, devices, ordered=self._placement is not None)
         self.time_plan = time.perf_counter() - t0
 
         t0 = time.perf_counter()

@@ -1,0 +1,90 @@
+"""Shared machinery for the exchange benchmarks (exchange_weak,
+exchange_strong, bench_exchange): build a domain, run fused exchange loops,
+report trimean statistics — the structure of the reference's timed exchange
+loop (reference: bin/exchange_weak.cu:140-196)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..api import DistributedDomain
+from ..geometry import Dim3, Radius
+from ..parallel import IntraNodeRandom, Method, NodeAware, Trivial
+from ..utils.statistics import Statistics
+from ..utils.sync import hard_sync
+
+
+def placement_from_flags(naive: bool, random_: bool):
+    """--naive -> Trivial, --random -> IntraNodeRandom, default NodeAware
+    (reference: bin/exchange_weak.cu:149-153, exchange_strong.cu)."""
+    if naive:
+        return Trivial()
+    if random_:
+        return IntraNodeRandom()
+    return NodeAware()
+
+
+def time_exchange(
+    size: Dim3,
+    radius: Radius,
+    iters: int,
+    method: Method = Method.AXIS_COMPOSED,
+    devices: Optional[Sequence] = None,
+    placement=None,
+    quantities: int = 4,
+    dtype: str = "float32",
+    chunk: int = 10,
+    prefix: str = "",
+) -> dict:
+    """Realize a domain with ``quantities`` quantities and time ``iters``
+    exchanges in fused chunks. Returns stats + the domain."""
+    devices = list(devices) if devices is not None else jax.devices()
+    dd = DistributedDomain(size.x, size.y, size.z)
+    dd.set_radius(radius)
+    dd.set_methods(method)
+    dd.set_devices(devices)
+    if placement is not None:
+        dd.set_placement(placement)
+    if prefix:
+        dd.set_output_prefix(prefix)
+    for i in range(quantities):
+        dd.add_data(f"d{i}", dtype)
+    dd.realize()
+
+    state = dd.curr_state()
+    chunk = max(1, min(chunk, iters))
+    tail = iters % chunk
+    loops = {chunk: dd._exchange.make_loop(chunk)}
+    if tail:
+        loops[tail] = dd._exchange.make_loop(tail)
+    # compile + warm every loop size OUTSIDE the timed region
+    for fn in loops.values():
+        state = fn(state)
+    hard_sync(state)
+
+    stats = Statistics()
+    done = 0
+    while done < iters:
+        k = min(chunk, iters - done)
+        t0 = time.perf_counter()
+        state = loops[k](state)
+        hard_sync(state)
+        stats.insert((time.perf_counter() - t0) / k)
+        done += k
+    dd._curr = dict(state)  # the loops donated the original buffers
+    itemsizes = [jnp.dtype(dtype).itemsize] * quantities
+    return {
+        "domain": dd,
+        "stats": stats,
+        "trimean_s": stats.trimean(),
+        "min_s": stats.min(),
+        "bytes_logical": dd._exchange.bytes_logical(itemsizes),
+        "bytes_moved": dd._exchange.bytes_moved(itemsizes),
+        "gb_per_s": dd._exchange.bytes_logical(itemsizes) / stats.trimean() / 1e9,
+        "local_size": dd.spec.base,
+        "devices": len(devices),
+    }

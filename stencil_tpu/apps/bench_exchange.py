@@ -1,0 +1,92 @@
+"""bench-exchange — radius-shape sweep of the halo exchange.
+
+TPU-native port of the reference sweep (reference: bin/bench_exchange.cu):
+five radius shapes (+x-leaning, x-only, faces-only, face+edge, uniform) at a
+fixed per-run extent, reporting trimean seconds and aggregate B/s.
+
+Usage: python -m stencil_tpu.apps.bench_exchange --x 256 --y 256 --z 256 --iters 30
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import jax
+
+from ..geometry import Dim3, Radius
+from ..parallel import Method
+from ._bench_common import time_exchange
+
+
+def sweep_radii(face: int = 2, edge: int = 1):
+    """The five shapes of the reference sweep (bin/bench_exchange.cu:126-195)."""
+    px = Radius.constant(0)
+    px.set_dir((1, 0, 0), face)
+
+    x_only = Radius.constant(0)
+    x_only.set_dir((1, 0, 0), face)
+    x_only.set_dir((-1, 0, 0), face)
+
+    faces = Radius.constant(0)
+    faces.set_face(face)
+
+    face_edge = Radius.constant(face)
+    face_edge.set_corner(edge)
+
+    uniform = Radius.constant(2)
+    return [
+        (f"px/{face}", px),
+        (f"x/{face}", x_only),
+        (f"faces/{face}", faces),
+        (f"face&edge/{face}/{edge}", face_edge),
+        ("uniform/2", uniform),
+    ]
+
+
+def run(x, y, z, iters=30, quantities=4, devices=None, method=Method.AXIS_COMPOSED):
+    devices = list(devices) if devices is not None else jax.devices()
+    rows = []
+    for name, radius in sweep_radii():
+        r = time_exchange(
+            Dim3(x, y, z), radius, iters, method=method, devices=devices,
+            quantities=quantities,
+        )
+        rows.append(
+            {
+                "config": f"{x}-{y}-{z}/{name}",
+                "bytes": r["bytes_logical"],
+                "trimean_s": r["trimean_s"],
+                "bytes_per_s": r["bytes_logical"] / r["trimean_s"],
+            }
+        )
+    return rows
+
+
+def report_header() -> str:
+    return "config,bytes,trimean (s),B/s"
+
+
+def report_row(row: dict) -> str:
+    return f"{row['config']},{row['bytes']},{row['trimean_s']:e},{row['bytes_per_s']:e}"
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="halo exchange radius-shape sweep")
+    p.add_argument("--x", type=int, default=256)
+    p.add_argument("--y", type=int, default=256)
+    p.add_argument("--z", type=int, default=256)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--cpu", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+    print(report_header())
+    for row in run(args.x, args.y, args.z, iters=args.iters):
+        print(report_row(row))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

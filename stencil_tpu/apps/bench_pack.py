@@ -1,0 +1,96 @@
+"""bench-pack — halo pack/unpack primitive throughput per direction.
+
+TPU-native port of the reference pack-kernel benchmark (reference:
+bin/bench_pack.cu): for each of the 26 directions, time gathering the halo
+region into a flat buffer and scattering it back. On TPU the pack kernel is
+``lax.dynamic_slice`` + reshape and unpack is ``dynamic_update_slice`` —
+this measures those primitives fused in a loop on one device.
+
+Usage: python -m stencil_tpu.apps.bench_pack --x 512 --y 512 --z 512 --iters 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..geometry import DIRECTIONS_26, Dim3, Radius, halo_rect, raw_size
+from ..utils.sync import hard_sync
+
+
+def pack_fn(rect, iters):
+    zyx = (
+        slice(rect.lo.z, rect.hi.z),
+        slice(rect.lo.y, rect.hi.y),
+        slice(rect.lo.x, rect.hi.x),
+    )
+
+    @jax.jit
+    def fn(arr, acc):
+        def body(_, carry):
+            arr, acc = carry
+            buf = arr[zyx].reshape(-1)  # pack: gather to flat buffer
+            arr = arr.at[zyx].set(buf.reshape(arr[zyx].shape) + 1)  # unpack
+            return arr, acc + buf[0]
+
+        return lax.fori_loop(0, iters, body, (arr, acc))
+
+    return fn
+
+
+def run(x, y, z, radius=3, iters=50, device=None):
+    device = device or jax.devices()[0]
+    r = Radius.constant(radius)
+    size = Dim3(x, y, z)
+    padded = raw_size(size, r)
+    arr = jax.device_put(
+        jnp.zeros((padded.z, padded.y, padded.x), jnp.float32), device
+    )
+    rows = []
+    for d in DIRECTIONS_26:
+        rect = halo_rect(d, size, r, halo=True)
+        bytes_ = rect.extent().flatten() * 4
+        fn = pack_fn(rect, iters)
+        arr, acc = fn(arr, jnp.float32(0))  # compile + warm
+        hard_sync(arr)
+        t0 = time.perf_counter()
+        arr, acc = fn(arr, acc)
+        hard_sync(arr)
+        dt = (time.perf_counter() - t0) / iters
+        rows.append(
+            {
+                "dir": (d.x, d.y, d.z),
+                "bytes": bytes_,
+                "s_per_op": dt,
+                "gb_per_s": 2 * bytes_ / dt / 1e9,  # pack + unpack traffic
+            }
+        )
+    return rows
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="halo pack/unpack primitive benchmark")
+    p.add_argument("--x", type=int, default=512)
+    p.add_argument("--y", type=int, default=512)
+    p.add_argument("--z", type=int, default=512)
+    p.add_argument("--radius", type=int, default=3)
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--cpu", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+    print("dir,bytes,s/op,GB/s")
+    for row in run(args.x, args.y, args.z, radius=args.radius, iters=args.iters):
+        d = row["dir"]
+        print(f"({d[0]} {d[1]} {d[2]}),{row['bytes']},{row['s_per_op']:e},{row['gb_per_s']:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

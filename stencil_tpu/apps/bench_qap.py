@@ -1,0 +1,91 @@
+"""bench-qap — QAP solver benchmark.
+
+TPU-native port of the reference solver benchmark (reference:
+bin/bench_qap.cu:16-60): times the exact and greedy solvers on random,
+matched (cost rewards identity), and block-diagonal matrices across sizes,
+comparing the native C++ and pure-Python implementations.
+
+Usage: python -m stencil_tpu.apps.bench_qap --sizes 4 6 8 --catch-sizes 16 32 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..parallel import qap
+
+
+def make_matrices(kind: str, n: int, rng: np.random.RandomState):
+    if kind == "random":
+        w = rng.rand(n, n)
+        d = rng.rand(n, n)
+    elif kind == "matched":
+        # distance ~ weight so identity is near-optimal
+        w = rng.rand(n, n)
+        d = 1.0 / (w + 0.1)
+    elif kind == "block":
+        blocks = -(-n // 4)  # ceil: kron result must cover n before cropping
+        w = np.kron(np.eye(blocks), np.ones((4, 4)))[:n, :n] + 0.01
+        d = rng.rand(n, n)
+    else:
+        raise ValueError(kind)
+    np.fill_diagonal(w, 0)
+    np.fill_diagonal(d, 0)
+    return w, d
+
+
+def run(sizes=(4, 6, 8), catch_sizes=(16, 32, 64), timeout_s=2.0):
+    rng = np.random.RandomState(0)
+    rows = []
+    for kind in ("random", "matched", "block"):
+        for n in sizes:
+            w, d = make_matrices(kind, n, rng)
+            for use_native in (True, False):
+                if not use_native and n > 6:
+                    continue  # pure-Python exhaustive search is too slow
+                t0 = time.perf_counter()
+                _, cost = qap.solve(w, d, timeout_s=timeout_s, use_native=use_native)
+                rows.append(
+                    {
+                        "solver": "exact" + ("-native" if use_native else "-py"),
+                        "kind": kind,
+                        "n": n,
+                        "cost": cost,
+                        "s": time.perf_counter() - t0,
+                    }
+                )
+        for n in catch_sizes:
+            w, d = make_matrices(kind, n, rng)
+            for use_native in (True, False):
+                t0 = time.perf_counter()
+                _, cost = qap.solve_catch(w, d, use_native=use_native)
+                rows.append(
+                    {
+                        "solver": "catch" + ("-native" if use_native else "-py"),
+                        "kind": kind,
+                        "n": n,
+                        "cost": cost,
+                        "s": time.perf_counter() - t0,
+                    }
+                )
+    return rows
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="QAP solver benchmark")
+    p.add_argument("--sizes", type=int, nargs="+", default=[4, 6, 8])
+    p.add_argument("--catch-sizes", type=int, nargs="+", default=[16, 32, 64])
+    p.add_argument("--timeout", type=float, default=2.0)
+    args = p.parse_args(argv)
+    print("solver,kind,n,cost,s")
+    for row in run(tuple(args.sizes), tuple(args.catch_sizes), args.timeout):
+        print(f"{row['solver']},{row['kind']},{row['n']},{row['cost']:.4f},{row['s']:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,13 +1,23 @@
 from .mesh import AXIS_X, AXIS_Y, AXIS_Z, MESH_AXES, grid_mesh, mesh_dim
-from .exchange import Method, HaloExchange, direction_bytes
+from .exchange import BLOCK_PSPEC, Method, HaloExchange, direction_bytes
+from .placement import IntraNodeRandom, NodeAware, Placement, Trivial, comm_matrix
+from .topology import Boundary, Topology
 
 __all__ = [
     "AXIS_X",
     "AXIS_Y",
     "AXIS_Z",
+    "BLOCK_PSPEC",
+    "Boundary",
+    "HaloExchange",
+    "IntraNodeRandom",
     "MESH_AXES",
     "Method",
-    "HaloExchange",
+    "NodeAware",
+    "Placement",
+    "Topology",
+    "Trivial",
+    "comm_matrix",
     "direction_bytes",
     "grid_mesh",
     "mesh_dim",

@@ -29,13 +29,14 @@ AXIS_Z = "z"
 MESH_AXES = (AXIS_Z, AXIS_Y, AXIS_X)
 
 
-def grid_mesh(dim, devices: Optional[Sequence] = None) -> Mesh:
+def grid_mesh(dim, devices: Optional[Sequence] = None, ordered: bool = False) -> Mesh:
     """Build a ``(dz, dy, dx)`` mesh for a partition grid ``dim`` (x, y, z).
 
-    ``devices=None`` uses all local devices through
-    ``mesh_utils.create_device_mesh`` (topology-aware on real TPU slices —
-    the NodeAware analogue); an explicit device list is laid out in the
-    given order (the Trivial-placement analogue, partition.hpp:291).
+    ``devices=None`` uses all local devices; on a real multi-chip TPU slice
+    the layout goes through ``mesh_utils.create_device_mesh`` (ICI-aware —
+    the built-in NodeAware analogue). ``ordered=True`` keeps the caller's
+    exact device order (used when a Placement strategy has already arranged
+    them; the Trivial-placement analogue, partition.hpp:291).
     """
     d = Dim3.of(dim)
     shape = (d.z, d.y, d.x)
@@ -47,7 +48,12 @@ def grid_mesh(dim, devices: Optional[Sequence] = None) -> Mesh:
     n = int(np.prod(shape))
     if len(devices) != n:
         raise ValueError(f"partition {d} needs {n} devices, have {len(devices)}")
-    if n > 1 and len({dev.platform for dev in devices}) == 1 and devices[0].platform == "tpu":
+    if (
+        not ordered
+        and n > 1
+        and len({dev.platform for dev in devices}) == 1
+        and devices[0].platform == "tpu"
+    ):
         from jax.experimental import mesh_utils
 
         arr = mesh_utils.create_device_mesh(shape, devices=devices)

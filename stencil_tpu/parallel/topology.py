@@ -1,0 +1,37 @@
+"""Logical neighbor topology over the subdomain grid.
+
+TPU-native analogue of the reference ``Topology``
+(reference: include/stencil/topology.hpp:9-30, src/topology.cpp) — periodic
+boundaries only, like the reference (non-periodic is fatal there)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+from ..geometry import Dim3
+
+
+class Boundary(enum.Enum):
+    NONE = 0
+    PERIODIC = 1
+
+
+@dataclass(frozen=True)
+class Neighbor:
+    index: Dim3
+    exists: bool
+
+
+class Topology:
+    def __init__(self, extent, boundary: Boundary = Boundary.PERIODIC):
+        if boundary != Boundary.PERIODIC:
+            raise ValueError("only periodic boundaries are supported (as in the reference)")
+        self.extent = Dim3.of(extent)
+        self.boundary = boundary
+
+    def get_neighbor(self, index, direction) -> Neighbor:
+        idx = Dim3.of(index)
+        d = Dim3.of(direction)
+        assert abs(d.x) <= 1 and abs(d.y) <= 1 and abs(d.z) <= 1
+        return Neighbor(index=(idx + d).wrap(self.extent), exists=True)

@@ -1,0 +1,66 @@
+"""Smoke tests for the benchmark apps (small sizes, virtual CPU mesh) —
+ensures each produces the reference-format CSV and sane numbers."""
+
+import jax
+import numpy as np
+import pytest
+
+from stencil_tpu.apps import bench_exchange, bench_pack, bench_qap, exchange_strong, exchange_weak, pingpong
+
+
+def test_exchange_weak_csv():
+    r = exchange_weak.run(8, 8, 8, iters=4, devices=jax.devices()[:8])
+    row = exchange_weak.csv_row(r)
+    parts = row.split(",")
+    assert parts[0] == "exchange"
+    assert len(parts) == 16
+    assert r["trimean_s"] > 0
+    assert r["bytes_logical"] > 0
+    # weak scaling grew the domain for 8 devices
+    assert r["x"] * r["y"] * r["z"] == 8 * 8 * 8 * 8
+
+
+def test_exchange_strong_fixed_domain():
+    r = exchange_strong.run(16, 16, 16, iters=2, devices=jax.devices()[:8])
+    assert (r["x"], r["y"], r["z"]) == (16, 16, 16)
+
+
+def test_exchange_weak_placement_flags():
+    r = exchange_weak.run(8, 8, 8, iters=2, naive=True, devices=jax.devices()[:8])
+    assert r["naive"] == 1
+
+
+def test_bench_exchange_sweep():
+    rows = bench_exchange.run(16, 16, 16, iters=2, devices=jax.devices()[:8])
+    assert len(rows) == 5
+    names = [r["config"].split("/")[1] for r in rows]
+    assert names == ["px", "x", "faces", "face&edge", "uniform"]
+    for r in rows:
+        assert r["bytes"] > 0 and r["trimean_s"] > 0
+    # faces-only moves more halo bytes than x-only
+    assert rows[2]["bytes"] > rows[1]["bytes"]
+
+
+def test_bench_pack_rows():
+    rows = bench_pack.run(16, 16, 16, radius=2, iters=3)
+    assert len(rows) == 26
+    face = next(r for r in rows if r["dir"] == (1, 0, 0))
+    corner = next(r for r in rows if r["dir"] == (1, 1, 1))
+    assert face["bytes"] == 2 * 16 * 16 * 4
+    assert corner["bytes"] == 2 * 2 * 2 * 4
+
+
+def test_bench_qap_rows():
+    rows = bench_qap.run(sizes=(4,), catch_sizes=(8,), timeout_s=1.0)
+    assert any(r["solver"] == "exact-native" for r in rows) or any(
+        r["solver"] == "exact-py" for r in rows
+    )
+    for r in rows:
+        assert np.isfinite(r["cost"]) and r["s"] >= 0
+
+
+def test_pingpong_rows():
+    rows = pingpong.run(min_bytes=8, max_bytes=128, iters=3, devices=jax.devices()[:2])
+    assert len(rows) >= 2
+    for r in rows:
+        assert r["latency_us"] > 0 and r["gb_per_s"] > 0
