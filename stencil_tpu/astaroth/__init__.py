@@ -1,0 +1,22 @@
+"""Astaroth MHD mini-app — the "joint stencils over multiple data types"
+workload (reference: astaroth/ in socal-ucr/stencil, a vendored, trimmed
+copy of the Astaroth magnetohydrodynamics code driven by the halo-exchange
+library).
+
+Eight double-precision fields (lnrho, uux/y/z, ax/y/z, entropy), radius-3
+halos, 6th-order centered finite differences, Williamson RK3 low-storage
+integration, with the interior/exchange/exterior overlap structure per
+substep."""
+
+from .config import AcMeshInfo, load_config
+from .fd import FieldData, field_data
+from .integrate import make_astaroth_step, rk3_integrate
+
+__all__ = [
+    "AcMeshInfo",
+    "FieldData",
+    "field_data",
+    "load_config",
+    "make_astaroth_step",
+    "rk3_integrate",
+]

@@ -1,0 +1,159 @@
+"""Williamson RK3 integration and the fused distributed Astaroth step.
+
+TPU-native re-design of the reference's integration driver (reference:
+astaroth/integration.cuh:14-49 ``rk3_integrate``; astaroth/kernels.cu:62-87
+``integrate_substep`` dispatch; astaroth/astaroth.cu:551-663 iteration
+structure): per iteration, three RK3 substeps each do
+{interior integrate -> halo exchange -> exterior integrate}, then the
+in/out buffers swap once. The reference's 1 + 26 CUDA streams per domain
+become dataflow inside one jitted program: the interior sweep of each
+substep depends only on pre-exchange data, so XLA can overlap the halo
+``ppermute``s with it.
+
+Note on semantics: this vendored workload evaluates all three stage rates
+on the same ``in`` state (buffers swap per *iteration*, not per substep —
+astaroth.cu:642-648). We replicate that for benchmark parity; pass
+``swap_per_substep=True`` for textbook low-storage RK3 feeding each stage
+forward.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+from jax import lax
+
+from ..geometry import Rect3, exterior_regions, interior_region
+from ..parallel.exchange import BLOCK_PSPEC, HaloExchange
+from .config import AcMeshInfo
+from .equations import Constants, continuity, entropy, induction, momentum
+from .fd import field_data
+
+FIELDS = ("lnrho", "uux", "uuy", "uuz", "ax", "ay", "az", "entropy")
+
+# Williamson (1980) low-storage coefficients (reference: integration.cuh:19-21)
+RK3_ALPHA = (0.0, -5.0 / 9.0, -153.0 / 128.0)
+RK3_BETA = (1.0 / 3.0, 15.0 / 16.0, 8.0 / 15.0)
+
+
+def rk3_integrate(step_number: int, state_previous, state_current, rate_of_change, dt):
+    """One low-storage RK3 stage (reference: integration.cuh:14-38).
+
+    ``state_previous`` is the out-buffer value (the previous stage's
+    output), ``state_current`` the in-buffer value."""
+    beta = RK3_BETA[step_number]
+    if step_number == 0:
+        return state_current + beta * rate_of_change * dt
+    alpha = RK3_ALPHA[step_number]
+    prev_beta = RK3_BETA[step_number - 1]
+    return state_current + beta * (
+        alpha / prev_beta * (state_current - state_previous) + rate_of_change * dt
+    )
+
+
+def _rects_slices(rect: Rect3):
+    return (
+        ...,
+        slice(rect.lo.z, rect.hi.z),
+        slice(rect.lo.y, rect.hi.y),
+        slice(rect.lo.x, rect.hi.x),
+    )
+
+
+def _integrate_region(
+    substep: int,
+    rect: Rect3,
+    inv_ds,
+    c: Constants,
+    dt,
+    curr: Dict[str, jax.Array],
+    out: Dict[str, jax.Array],
+) -> Dict[str, jax.Array]:
+    """Integrate one region: read curr fields' derivatives over ``rect``,
+    RK3-update the region in the out buffers (reference: solve<step> kernel,
+    user_kernels.h:437-469)."""
+    lnrho = field_data(curr["lnrho"], rect, inv_ds)
+    uu = tuple(field_data(curr[k], rect, inv_ds) for k in ("uux", "uuy", "uuz"))
+    aa = tuple(field_data(curr[k], rect, inv_ds) for k in ("ax", "ay", "az"))
+    ss = field_data(curr["entropy"], rect, inv_ds)
+
+    sl = _rects_slices(rect)
+    rates = {"lnrho": continuity(uu, lnrho)}
+    ind = induction(c, uu, aa)
+    mom = momentum(c, uu, lnrho, ss, aa)
+    for i, k in enumerate(("ax", "ay", "az")):
+        rates[k] = ind[i]
+    for i, k in enumerate(("uux", "uuy", "uuz")):
+        rates[k] = mom[i]
+    rates["entropy"] = entropy(c, ss, uu, lnrho, aa)
+
+    new_out = {}
+    for k in FIELDS:
+        updated = rk3_integrate(substep, out[k][sl], curr[k][sl], rates[k], dt)
+        new_out[k] = out[k].at[sl].set(updated.astype(out[k].dtype))
+    return new_out
+
+
+def make_astaroth_step(
+    ex: HaloExchange,
+    info: AcMeshInfo,
+    dt: float = 1e-8,
+    overlap: bool = True,
+    swap_per_substep: bool = False,
+    iters: int = 1,
+):
+    """Build the jitted iteration: ``fn(curr, nxt) -> (curr, nxt)`` where
+    curr/nxt are dicts of stacked sharded field arrays. Runs ``iters``
+    iterations of 3 substeps in one compiled program; the dt=1e-8 default
+    matches the reference driver (astaroth.cu:578)."""
+    spec = ex.spec
+    r = spec.radius
+    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
+        "astaroth needs face radius >= 3 (6th-order stencils)"
+    )
+    inv_ds = (
+        info.real_params["AC_inv_dsx"],
+        info.real_params["AC_inv_dsy"],
+        info.real_params["AC_inv_dsz"],
+    )
+    c = Constants.from_info(info)
+    off = spec.compute_offset()
+    compute = Rect3(off, off + spec.base)
+    interior = interior_region(compute, r)
+    exteriors = exterior_regions(compute, interior)
+    use_overlap = overlap and spec.is_uniform()
+
+    def substep_block(substep, curr, out):
+        if use_overlap:
+            out = _integrate_region(substep, interior, inv_ds, c, dt, curr, out)
+            curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+            for rect in exteriors:
+                out = _integrate_region(substep, rect, inv_ds, c, dt, curr, out)
+        else:
+            curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+            out = _integrate_region(substep, compute, inv_ds, c, dt, curr, out)
+        return curr, out
+
+    def iteration(curr, out):
+        for substep in range(3):
+            curr, out = substep_block(substep, curr, out)
+            if swap_per_substep:
+                curr, out = out, curr
+        if not swap_per_substep:
+            # reference workload: one swap per iteration (astaroth.cu:642-648)
+            curr, out = out, curr
+        return curr, out
+
+    def entry_fn(curr, out):
+        if iters == 1:
+            return iteration(curr, out)
+        return lax.fori_loop(0, iters, lambda _, co: iteration(co[0], co[1]), (curr, out))
+
+    fn = jax.shard_map(
+        entry_fn,
+        mesh=ex.mesh,
+        in_specs=(BLOCK_PSPEC, BLOCK_PSPEC),
+        out_specs=(BLOCK_PSPEC, BLOCK_PSPEC),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1))

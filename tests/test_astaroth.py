@@ -1,0 +1,365 @@
+"""Astaroth MHD tests.
+
+- config parser: values, comments, derived params, poison detection
+  (reference: astaroth_utils.cu behavior)
+- derivatives: 6th-order stencils against analytic sin/cos fields
+  (reference: test/test_derivative.cu idiom)
+- full distributed step vs an independent np.roll-based global reference
+  (halo mechanics + region decomposition + RK3 wiring)
+- reductions, init determinism, app smoke
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from stencil_tpu.astaroth import config as ac_config
+from stencil_tpu.astaroth import fd
+from stencil_tpu.astaroth import equations as eq
+from stencil_tpu.astaroth.init import const_init, hash_init, radial_explosion_init, sin_init
+from stencil_tpu.astaroth.integrate import FIELDS, make_astaroth_step, rk3_integrate
+from stencil_tpu.astaroth.reductions import Reductions
+from stencil_tpu.apps.astaroth import DEFAULT_CONF, decompose_zyx, run as astaroth_run
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius, Rect3
+from stencil_tpu.parallel import HaloExchange, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
+
+
+# -- config -------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_parse_reference_values(self):
+        info, ok = ac_config.load_config(DEFAULT_CONF)
+        # like the reference's default conf, AC_dt is intentionally unset
+        # (the driver overrides it, astaroth.cu:578) -> poison check fires
+        assert not ok and info.uninitialized() == ["AC_dt"]
+        assert info.int_params["AC_nx"] == 256
+        assert info.real_params["AC_dsx"] == pytest.approx(0.04908738521)
+        assert info.real_params["AC_gamma"] == 0.5
+        # derived (reference: astaroth_utils.cu:52-88)
+        assert info.int_params["AC_mx"] == 256 + 6
+        assert info.int_params["AC_nx_min"] == 3
+        assert info.int_params["AC_nx_max"] == 259
+        assert info.real_params["AC_inv_dsx"] == pytest.approx(1 / 0.04908738521)
+        assert info.real_params["AC_cs2_sound"] == pytest.approx(1.0)
+
+    def test_poison_detection(self):
+        info = ac_config.AcMeshInfo()
+        ac_config.parse_config("AC_nx = 8\nAC_ny = 8\nAC_nz = 8\n", info)
+        assert "AC_dsx" in info.uninitialized()
+        assert "AC_nx" not in info.uninitialized()
+
+    def test_comments_ignored(self):
+        info = ac_config.AcMeshInfo()
+        ac_config.parse_config(
+            "/* block\ncomment */\nAC_nx = 4 // trailing\n// AC_ny = 9\nAC_ny = 5\nAC_nz=6\n",
+            info,
+        )
+        assert info.int_params["AC_nx"] == 4
+        assert info.int_params["AC_ny"] == 5
+        assert info.int_params["AC_nz"] == 6
+
+
+# -- derivatives --------------------------------------------------------------
+
+
+def periodic_padded(f_global: np.ndarray, r: int = 3) -> np.ndarray:
+    """Pad a global [z,y,x] array with its periodic wrap."""
+    return np.pad(f_global, r, mode="wrap")
+
+
+class TestDerivatives:
+    def setup_method(self):
+        n = 32
+        L = 2 * np.pi
+        self.ds = L / n
+        idx = np.arange(n) * self.ds
+        self.z, self.y, self.x = np.meshgrid(idx, idx, idx, indexing="ij", sparse=True)
+        self.rect = Rect3(Dim3(3, 3, 3), Dim3(3 + n, 3 + n, 3 + n))
+        self.inv = 1.0 / self.ds
+
+    def test_derx_sin(self):
+        f = periodic_padded(np.sin(self.x) + 0 * self.z * self.y)
+        got = np.asarray(fd.derx(f, self.rect, self.inv))
+        want = np.broadcast_to(np.cos(self.x), got.shape)
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_derzz_sin(self):
+        f = periodic_padded(np.sin(self.z) + 0 * self.x * self.y)
+        got = np.asarray(fd.derzz(f, self.rect, self.inv))
+        want = np.broadcast_to(-np.sin(self.z), got.shape)
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_derxy_product(self):
+        f = periodic_padded(np.sin(self.x) * np.sin(self.y) + 0 * self.z)
+        got = np.asarray(fd.derxy(f, self.rect, self.inv, self.inv))
+        want = np.broadcast_to(np.cos(self.x) * np.cos(self.y), got.shape)
+        np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_deryz_product(self):
+        f = periodic_padded(np.sin(self.y) * np.sin(self.z) + 0 * self.x)
+        got = np.asarray(fd.deryz(f, self.rect, self.inv, self.inv))
+        want = np.broadcast_to(np.cos(self.y) * np.cos(self.z), got.shape)
+        np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_laplace_plane_wave(self):
+        f3 = np.sin(self.x + self.y + self.z)
+        f = periodic_padded(f3)
+        data = fd.field_data(f, self.rect, (self.inv, self.inv, self.inv))
+        np.testing.assert_allclose(np.asarray(data.laplace()), -3 * f3, atol=2e-4)
+
+
+# -- equations on trivial fields ---------------------------------------------
+
+
+def make_constants():
+    info, _ = ac_config.load_config(DEFAULT_CONF)
+    return eq.Constants.from_info(info)
+
+
+class TestEquationsTrivial:
+    def test_all_rates_zero_on_uniform_fields(self):
+        n = 8
+        r = Rect3(Dim3(3, 3, 3), Dim3(3 + n, 3 + n, 3 + n))
+        inv = (1.0, 1.0, 1.0)
+        c = make_constants()
+        fields = {
+            "lnrho": np.full((n + 6,) * 3, 0.5),
+            "entropy": np.full((n + 6,) * 3, 0.25),
+        }
+        for k in ("uux", "uuy", "uuz", "ax", "ay", "az"):
+            fields[k] = np.full((n + 6,) * 3, 0.125)
+        lnrho = fd.field_data(fields["lnrho"], r, inv)
+        ss = fd.field_data(fields["entropy"], r, inv)
+        uu = tuple(fd.field_data(fields[k], r, inv) for k in ("uux", "uuy", "uuz"))
+        aa = tuple(fd.field_data(fields[k], r, inv) for k in ("ax", "ay", "az"))
+        np.testing.assert_allclose(np.asarray(eq.continuity(uu, lnrho)), 0.0, atol=1e-12)
+        for comp in eq.induction(c, uu, aa):
+            np.testing.assert_allclose(np.asarray(comp), 0.0, atol=1e-12)
+        for comp in eq.momentum(c, uu, lnrho, ss, aa):
+            np.testing.assert_allclose(np.asarray(comp), 0.0, atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(eq.entropy(c, ss, uu, lnrho, aa)), 0.0, atol=1e-12
+        )
+
+
+# -- RK3 ----------------------------------------------------------------------
+
+
+def test_rk3_first_step_euler_third():
+    # step 0: u + (1/3) f dt (reference: integration.cuh beta[1] = 1/3)
+    got = rk3_integrate(0, 99.0, 2.0, 3.0, 0.5)
+    assert got == pytest.approx(2.0 + (1.0 / 3.0) * 3.0 * 0.5)
+
+
+def test_rk3_scalar_sequence_converges():
+    # du/dt = -u with swap-per-substep: one full RK3 iteration should give
+    # roughly exp(-dt) decay
+    dt = 0.01
+    curr, out = 1.0, 0.0
+    for s in range(3):
+        rate = -curr
+        out = rk3_integrate(s, out, curr, rate, dt)
+        curr, out = out, curr
+    assert curr == pytest.approx(np.exp(-dt), rel=1e-6)
+
+
+# -- full distributed step vs np.roll global reference ------------------------
+
+
+def roll_field_data(f: np.ndarray, inv_ds) -> fd.FieldData:
+    """Independent derivative implementation: periodic np.roll over the
+    global array (no halos, no regions)."""
+
+    def sh(dz, dy, dx):
+        return np.roll(f, (-dz, -dy, -dx), (0, 1, 2))
+
+    def first(axis_shift, inv):
+        res = 0.0
+        for i, cc in enumerate(fd.FIRST_COEFFS, start=1):
+            res = res + cc * (sh(*axis_shift(i)) - sh(*axis_shift(-i)))
+        return res * inv
+
+    def second(axis_shift, inv):
+        res = fd.SECOND_CENTER * f
+        for i, cc in enumerate(fd.SECOND_COEFFS, start=1):
+            res = res + cc * (sh(*axis_shift(i)) + sh(*axis_shift(-i)))
+        return res * inv * inv
+
+    def cross(shift_a, shift_b, inv_a, inv_b):
+        res = 0.0
+        for i, cc in enumerate(fd.CROSS_COEFFS, start=1):
+            res = res + cc * (
+                sh(*shift_a(i)) + sh(*shift_a(-i)) - sh(*shift_b(i)) - sh(*shift_b(-i))
+            )
+        return res * inv_a * inv_b
+
+    ix, iy, iz = inv_ds
+    return fd.FieldData(
+        value=f,
+        gx=first(lambda i: (0, 0, i), ix),
+        gy=first(lambda i: (0, i, 0), iy),
+        gz=first(lambda i: (i, 0, 0), iz),
+        hxx=second(lambda i: (0, 0, i), ix),
+        hxy=cross(lambda i: (0, i, i), lambda i: (0, -i, i), ix, iy),
+        hxz=cross(lambda i: (i, 0, i), lambda i: (-i, 0, i), ix, iz),
+        hyy=second(lambda i: (0, i, 0), iy),
+        hyz=cross(lambda i: (i, i, 0), lambda i: (-i, i, 0), iy, iz),
+        hzz=second(lambda i: (i, 0, 0), iz),
+    )
+
+
+def global_reference_iteration(fields, out, info, dt):
+    """One reference-workload iteration (3 substeps over the same input,
+    swap at the end) on global periodic arrays."""
+    c = eq.Constants.from_info(info)
+    inv = (
+        info.real_params["AC_inv_dsx"],
+        info.real_params["AC_inv_dsy"],
+        info.real_params["AC_inv_dsz"],
+    )
+    for substep in range(3):
+        lnrho = roll_field_data(fields["lnrho"], inv)
+        ss = roll_field_data(fields["entropy"], inv)
+        uu = tuple(roll_field_data(fields[k], inv) for k in ("uux", "uuy", "uuz"))
+        aa = tuple(roll_field_data(fields[k], inv) for k in ("ax", "ay", "az"))
+        rates = {"lnrho": np.asarray(eq.continuity(uu, lnrho))}
+        for i, k in enumerate(("ax", "ay", "az")):
+            rates[k] = np.asarray(eq.induction(c, uu, aa)[i])
+        for i, k in enumerate(("uux", "uuy", "uuz")):
+            rates[k] = np.asarray(eq.momentum(c, uu, lnrho, ss, aa)[i])
+        rates["entropy"] = np.asarray(eq.entropy(c, ss, uu, lnrho, aa))
+        for k in FIELDS:
+            out[k] = np.asarray(rk3_integrate(substep, out[k], fields[k], rates[k], dt))
+    return out, fields  # swap
+
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_distributed_step_matches_global_reference(overlap):
+    n = 16
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    dt = 1e-3
+
+    size = Dim3(n, n, n)
+    rng = np.random.RandomState(0)
+    fields = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
+    fields["lnrho"] = fields["lnrho"] + 0.5
+
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(3))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    ex = HaloExchange(spec, mesh)
+    step = make_astaroth_step(ex, info, dt=dt, overlap=overlap)
+
+    curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
+    nxt = {k: shard_blocks(np.zeros((n, n, n)), spec, mesh) for k in FIELDS}
+    curr, nxt = step(curr, nxt)
+    got = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
+
+    ref_out = {k: np.zeros((n, n, n)) for k in FIELDS}
+    ref_curr, _ = global_reference_iteration(dict(fields), ref_out, info, dt)
+
+    for k in FIELDS:
+        np.testing.assert_allclose(got[k], ref_curr[k], rtol=1e-10, atol=1e-12, err_msg=k)
+
+
+def test_two_iterations_match():
+    """Second iteration consumes exchanged halos of RK3 output — catches
+    stale-halo bugs that a single iteration can't."""
+    n = 16
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    dt = 1e-3
+    size = Dim3(n, n, n)
+    rng = np.random.RandomState(1)
+    fields = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
+    fields["lnrho"] = fields["lnrho"] + 0.5
+
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(3))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    ex = HaloExchange(spec, mesh)
+    step = make_astaroth_step(ex, info, dt=dt)
+    curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
+    nxt = {k: shard_blocks(np.zeros((n, n, n)), spec, mesh) for k in FIELDS}
+    for _ in range(2):
+        curr, nxt = step(curr, nxt)
+    got = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
+
+    a = dict(fields)
+    b = {k: np.zeros((n, n, n)) for k in FIELDS}
+    for _ in range(2):
+        a, b = global_reference_iteration(a, b, info, dt)
+    for k in FIELDS:
+        np.testing.assert_allclose(got[k], a[k], rtol=1e-9, atol=1e-11, err_msg=k)
+
+
+# -- init + reductions + app --------------------------------------------------
+
+
+def test_init_determinism_and_ranges():
+    h = hash_init((8, 8, 8))
+    assert h.min() >= -1.0 and h.max() <= 1.0
+    np.testing.assert_array_equal(h, hash_init((8, 8, 8)))
+    assert const_init((4, 4, 4), 0.5)[0, 0, 0] == 0.5
+    s = sin_init((8, 16, 8))
+    assert s.shape == (8, 16, 8)
+    ux, uy, uz = radial_explosion_init((8, 8, 8))
+    assert np.isfinite(ux).all() and np.isfinite(uy).all() and np.isfinite(uz).all()
+
+
+def test_reductions_match_numpy():
+    n = 8
+    spec = GridSpec(Dim3(n, n, n), Dim3(2, 2, 2), Radius.constant(3))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    ex = HaloExchange(spec, mesh)
+    rng = np.random.RandomState(2)
+    f = rng.randn(n, n, n)
+    arr = shard_blocks(f, spec, mesh)
+    red = Reductions(ex)
+    got = red.scal(arr)
+    assert got["max"] == pytest.approx(f.max())
+    assert got["min"] == pytest.approx(f.min())
+    assert got["sum"] == pytest.approx(f.sum(), rel=1e-12)
+    assert got["rms"] == pytest.approx(np.sqrt((f**2).mean()), rel=1e-12)
+    # vector magnitude reduction
+    g = rng.randn(n, n, n)
+    h = rng.randn(n, n, n)
+    got = red.vec(arr, shard_blocks(g, spec, mesh), shard_blocks(h, spec, mesh))
+    mag = np.sqrt(f**2 + g**2 + h**2)
+    assert got["max"] == pytest.approx(mag.max())
+    assert got["rms"] == pytest.approx(np.sqrt((mag**2).mean()), rel=1e-12)
+
+
+def test_decompose_zyx():
+    assert decompose_zyx(8) == Dim3(2, 2, 2)
+    assert decompose_zyx(2) == Dim3(1, 1, 2)  # z gets the first factor
+    assert decompose_zyx(1) == Dim3(1, 1, 1)
+
+
+def test_app_smoke():
+    r = astaroth_run(iters=2, nx=8, devices=jax.devices()[:8], reductions=True)
+    assert r["iter_trimean_s"] > 0
+    assert r["exch_trimean_s"] > 0
+    assert r["global"] == Dim3(16, 16, 16)
+    for k, v in r["reductions"].items():
+        for stat in v.values():
+            assert np.isfinite(stat)
+
+
+def test_load_config_missing_extents_reports(tmp_path):
+    """Missing AC_nx must surface in the poison report, not crash the
+    derived-param computation."""
+    p = tmp_path / "bad.conf"
+    p.write_text("AC_dsx = 0.1\nAC_dsy = 0.1\nAC_dsz = 0.1\n")
+    info, ok = ac_config.load_config(str(p))
+    assert not ok
+    assert "AC_nx" in info.uninitialized()
