@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from ..api import DistributedDomain
 from ..geometry import Dim3, prime_factors
-from ..ops.jacobi import INIT_TEMP, make_jacobi_loop, make_jacobi_step, sphere_masks
+from ..ops.jacobi import INIT_TEMP, make_jacobi_loop, make_jacobi_step, sphere_sel
 from ..parallel import Method
 from ..parallel.exchange import shard_blocks
 from ..utils.statistics import Statistics
@@ -79,9 +79,7 @@ def run(
     sharding = dd.sharding()
     shape = dd.spec.stacked_shape_zyx()
     dd.set_curr(h, jax.device_put(jnp.full(shape, INIT_TEMP, jnp.float32), sharding))
-    hot_np, cold_np = sphere_masks(size)
-    hot = shard_blocks(hot_np, dd.spec, dd.mesh)
-    cold = shard_blocks(cold_np, dd.spec, dd.mesh)
+    sel = shard_blocks(sphere_sel(size), dd.spec, dd.mesh)
 
     if paraview:
         dd.write_paraview(prefix + "jacobi3d_init")
@@ -105,7 +103,7 @@ def run(
 
     loop = get_loop(chunk)
     for _ in range(warmup):  # compile + warm caches, excluded from timing
-        curr, nxt = loop(curr, nxt, hot, cold)
+        curr, nxt = loop(curr, nxt, sel)
     if warmup:
         hard_sync(curr)
 
@@ -121,7 +119,7 @@ def run(
         k = min(chunk, iters - done)
         fn = get_loop(k)
         t0 = time.perf_counter()
-        curr, nxt = fn(curr, nxt, hot, cold)
+        curr, nxt = fn(curr, nxt, sel)
         hard_sync(curr)
         iter_time.insert((time.perf_counter() - t0) / k)
         done += k

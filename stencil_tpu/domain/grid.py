@@ -34,11 +34,23 @@ def _axis_sizes(total: int, n: int, base: int) -> Tuple[int, ...]:
     return tuple(base - (1 if (rem != 0 and i >= rem) else 0) for i in range(n))
 
 
+# TPU tiling alignment for the block's minor dims: sublanes (y) and lanes
+# (x). Slab DMAs in Pallas kernels require these; the pad tail beyond
+# raw_size is dead cells, exactly like the uneven-partition tail.
+ALIGN_Y = 8
+ALIGN_X = 128
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
 @dataclass(frozen=True)
 class GridSpec:
     global_size: Dim3
     dim: Dim3  # number of blocks along x, y, z
     radius: Radius
+    aligned: bool = True  # pad block planes to (ALIGN_Y, ALIGN_X) multiples
     base: Dim3 = field(init=False)  # largest block size
     sizes_x: Tuple[int, ...] = field(init=False)
     sizes_y: Tuple[int, ...] = field(init=False)
@@ -80,8 +92,12 @@ class GridSpec:
 
     # -- shapes --------------------------------------------------------------
     def padded(self) -> Dim3:
-        """Per-block allocation extent (x, y, z)."""
-        return raw_size(self.base, self.radius)
+        """Per-block allocation extent (x, y, z); when ``aligned``, the y/x
+        plane dims are rounded up to TPU tile multiples (dead tail)."""
+        p = raw_size(self.base, self.radius)
+        if not self.aligned:
+            return p
+        return Dim3(_round_up(p.x, ALIGN_X), _round_up(p.y, ALIGN_Y), p.z)
 
     def block_shape_zyx(self) -> Tuple[int, int, int]:
         p = self.padded()

@@ -5,6 +5,7 @@ from .jacobi import (
     make_jacobi_loop,
     make_jacobi_step,
     sphere_masks,
+    sphere_sel,
 )
 
 __all__ = [
@@ -14,4 +15,5 @@ __all__ = [
     "make_jacobi_loop",
     "make_jacobi_step",
     "sphere_masks",
+    "sphere_sel",
 ]

@@ -76,7 +76,7 @@ def jacobi6_block(block, radius: Radius, masks=None):
     return jacobi_sweep(block, block, Rect3(off, hi), masks)
 
 
-def make_jacobi_step(ex: HaloExchange, overlap: bool = True):
+def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None):
     """Build the jitted distributed iteration: exchange + stencil + swap.
 
     Returns ``step(curr, nxt, hot, cold) -> (new_curr, new_next)`` over
@@ -89,10 +89,10 @@ def make_jacobi_step(ex: HaloExchange, overlap: bool = True):
     read exchanged halos. On an uneven partition the step falls back to
     exchange-then-full-sweep (slab extents would be data-dependent).
     """
-    return _compile_jacobi(ex, overlap, iters=None)
+    return _compile_jacobi(ex, overlap, iters=None, use_pallas=use_pallas)
 
 
-def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True):
+def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pallas=None):
     """Like :func:`make_jacobi_step` but runs ``iters`` iterations inside one
     compiled program (``lax.fori_loop``) — one host dispatch per chunk.
 
@@ -101,10 +101,17 @@ def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True):
     the whole iteration loop, which also removes the per-call host
     round-trip of the tunneled TPU platform (~0.7 s each).
     """
-    return _compile_jacobi(ex, overlap, iters=iters)
+    return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas)
 
 
-def _compile_jacobi(ex: HaloExchange, overlap: bool, iters):
+def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
+    if use_pallas is not None:
+        return bool(use_pallas)
+    devs = ex.mesh.devices.flatten()
+    return ex.spec.aligned and all(d.platform == "tpu" for d in devs)
+
+
+def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None):
     spec = ex.spec
     r = spec.radius
     assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
@@ -116,7 +123,26 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters):
     exteriors = exterior_regions(compute, interior)
     use_overlap = overlap and spec.is_uniform()
 
-    def body(curr, nxt, masks):
+    pallas_sweep = None
+    if _want_pallas(ex, use_pallas):
+        from .pallas_stencil import make_pallas_jacobi_sweep, sel_z_range
+        from ..parallel.mesh import MESH_AXES
+
+        pallas_sweep = make_pallas_jacobi_sweep(spec, sel_z_range(spec), vma=MESH_AXES)
+
+    def body(curr, nxt, sel):
+        if pallas_sweep is not None:
+            # the Pallas sweep consumes exchanged halos, so the structure is
+            # exchange-then-sweep (overlap via dataflow does not apply here)
+            cur2 = ex.exchange_block(curr)
+            p = spec.padded()
+            out = pallas_sweep(
+                cur2.reshape(p.z, p.y, p.x),
+                nxt.reshape(p.z, p.y, p.x),
+                sel.reshape(p.z, p.y, p.x),
+            ).reshape(nxt.shape)
+            return out, cur2
+        masks = (sel == 1, sel == 2)
         if use_overlap:
             out = jacobi_sweep(curr, nxt, interior, masks)
             cur2 = ex.exchange_block(curr)
@@ -128,17 +154,17 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters):
         # swap: computed buffer becomes curr, old curr becomes scratch
         return out, cur2
 
-    def entry_fn(curr, nxt, hot, cold):
+    def entry_fn(curr, nxt, sel):
         if iters is None:
-            return body(curr, nxt, (hot, cold))
+            return body(curr, nxt, sel)
         return jax.lax.fori_loop(
-            0, iters, lambda _, cn: body(cn[0], cn[1], (hot, cold)), (curr, nxt)
+            0, iters, lambda _, cn: body(cn[0], cn[1], sel), (curr, nxt)
         )
 
     fn = jax.shard_map(
         entry_fn,
         mesh=ex.mesh,
-        in_specs=(BLOCK_PSPEC,) * 4,
+        in_specs=(BLOCK_PSPEC,) * 3,
         out_specs=(BLOCK_PSPEC, BLOCK_PSPEC),
     )
     return jax.jit(fn, donate_argnums=(0, 1))
@@ -167,6 +193,16 @@ def sphere_masks(global_size) -> Tuple[np.ndarray, np.ndarray]:
     hot = dist(hot_c) <= rad
     cold = (~hot) & (dist(cold_c) <= rad)
     return hot, cold
+
+
+def sphere_sel(global_size) -> np.ndarray:
+    """Hot/cold spheres packed into one int32 array: 0 stencil, 1 hot,
+    2 cold — the layout both compute paths consume."""
+    hot, cold = sphere_masks(global_size)
+    sel = np.zeros(hot.shape, np.int32)
+    sel[hot] = 1
+    sel[cold] = 2
+    return sel
 
 
 def jacobi_reference(field: np.ndarray, masks, iters: int) -> np.ndarray:

@@ -120,12 +120,18 @@ class HaloExchange:
     def __call__(self, state):
         return self._compiled(state)
 
-    def exchange_block(self, block):
+    def exchange_block(self, block, axes=None):
         """Per-block exchange body for composing into larger shard_map'd
         steps (e.g. fused compute/exchange overlap): takes and returns one
-        (1,1,1,pz,py,px) block inside a ``shard_map`` over this mesh."""
-        body = self._direct26_blocks if self.method == Method.DIRECT26 else self._composed_blocks
-        return body(block)
+        (1,1,1,pz,py,px) block inside a ``shard_map`` over this mesh.
+
+        ``axes`` (AXIS_* names) restricts the composed method to a subset of
+        axis phases — used by fused kernels that handle self-wrap axes
+        internally. Only valid for AXIS_COMPOSED."""
+        if self.method == Method.DIRECT26:
+            assert axes is None, "axis subsetting requires AXIS_COMPOSED"
+            return self._direct26_blocks(block)
+        return self._composed_blocks(block, axes)
 
     @cached_property
     def _compiled(self):
@@ -173,8 +179,10 @@ class HaloExchange:
         return per_item * sum(itemsizes) * self.spec.num_blocks()
 
     # -- axis-composed implementation ---------------------------------------
-    def _composed_blocks(self, block):
+    def _composed_blocks(self, block, axes=None):
         for name, adim, _ in _AXES:
+            if axes is not None and name not in axes:
+                continue
             block = self._axis_phase(block, name, adim)
         return block
 
