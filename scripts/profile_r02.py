@@ -1,0 +1,135 @@
+"""Round-2 profiling: isolate where the jacobi step's time goes.
+
+Times, each as a fused 10-iter loop on the real chip:
+  1. pallas sweep alone (double-buffered kernel)
+  2. pallas sweep with wrap=(1,1,1) (self-wrap, no exchange needed)
+  3. exchange_block alone (r=1, 1 quantity)
+  4. full jacobi step (current bench path)
+  5. exchange r=3 x 4 quantities (the exchange bench path)
+Also numerics: TPU pallas vs XLA path on 128^3.
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius, Rect3
+from stencil_tpu.parallel import HaloExchange, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks
+from stencil_tpu.ops.jacobi import make_jacobi_loop, sphere_sel, INIT_TEMP, jacobi_sweep
+from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_sweep, sel_z_range, _pick_tiles
+from stencil_tpu.utils.sync import hard_sync
+
+N = 512
+ITERS = 10
+dev = jax.devices()[:1]
+print("platform:", dev[0].platform, flush=True)
+
+spec = GridSpec(Dim3(N, N, N), Dim3(1, 1, 1), Radius.constant(1))
+p = spec.padded()
+print("padded:", p, "tiles:",
+      _pick_tiles(spec.base.z, spec.base.y, spec.compute_offset().y, p.y, p.x),
+      flush=True)
+
+
+def timeit(name, fn, *args, rebind=None):
+    """rebind: fn's outputs that replace args (for donated buffers)."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    hard_sync(out)
+    compile_s = time.perf_counter() - t0
+    best = 1e9
+    for _ in range(3):
+        a = rebind(out, args) if rebind else args
+        t0 = time.perf_counter()
+        out = fn(*a)
+        hard_sync(out)
+        best = min(best, time.perf_counter() - t0)
+        args = a
+    print(f"{name}: {best/ITERS*1000:.3f} ms/iter  (compile {compile_s:.1f}s)", flush=True)
+    return out
+
+
+# ---- numerics first: TPU pallas vs XLA on 128^3
+ns = 128
+spec_s = GridSpec(Dim3(ns, ns, ns), Dim3(1, 1, 1), Radius.constant(1))
+ps = spec_s.padded()
+rng = np.random.RandomState(0)
+cs = jnp.asarray(rng.rand(ps.z, ps.y, ps.x).astype(np.float32))
+nsx = jnp.zeros((ps.z, ps.y, ps.x), jnp.float32)
+off = spec_s.compute_offset()
+sl = (slice(off.z, off.z+ns), slice(off.y, off.y+ns), slice(off.x, off.x+ns))
+sel_s = np.zeros((ps.z, ps.y, ps.x), np.int32)
+sel_s[sl] = sphere_sel(Dim3(ns, ns, ns))
+sel_s = jnp.asarray(sel_s)
+sweep_s = make_pallas_jacobi_sweep(spec_s, sel_z_range(spec_s))
+got = np.asarray(jax.device_get(sweep_s(cs, nsx, sel_s)))
+rect = Rect3(off, off + spec_s.base)
+want = np.asarray(jax.device_get(
+    jacobi_sweep(cs, jnp.zeros_like(nsx), rect, (sel_s == 1, sel_s == 2))))
+err = np.abs(got[sl] - want[sl]).max()
+print("pallas-vs-xla max err (tpu, 128^3):", err, flush=True)
+assert err < 1e-6
+
+# wrap numerics: wrap=(1,1,1) vs np periodic reference
+sweep_w = make_pallas_jacobi_sweep(spec_s, sel_z_range(spec_s), wrap=(True, True, True))
+got_w = np.asarray(jax.device_get(sweep_w(cs, nsx, sel_s)))
+f = np.asarray(jax.device_get(cs))[sl].astype(np.float64)
+avg = (np.roll(f, 1, 2) + np.roll(f, -1, 2) + np.roll(f, 1, 1) + np.roll(f, -1, 1)
+       + np.roll(f, 1, 0) + np.roll(f, -1, 0)) / 6
+selc = np.asarray(sel_s[sl])
+avg = np.where(selc == 1, 1.0, np.where(selc == 2, 0.0, avg))
+err_w = np.abs(got_w[sl] - avg).max()
+print("pallas-wrap-vs-np max err:", err_w, flush=True)
+assert err_w < 1e-6
+
+# ---- 1. pallas sweep alone
+sweep = make_pallas_jacobi_sweep(spec, sel_z_range(spec))
+curr = jnp.full((p.z, p.y, p.x), INIT_TEMP, jnp.float32)
+nxt = jnp.zeros((p.z, p.y, p.x), jnp.float32)
+sel3 = jnp.zeros((p.z, p.y, p.x), jnp.int32)
+
+
+def make_sweep_loop(sw):
+    @jax.jit
+    def sweep_loop(c, x, s):
+        def body(_, cn):
+            c1, n1 = cn
+            return (sw(c1, n1, s), c1)
+        return lax.fori_loop(0, ITERS, body, (c, x))
+    return sweep_loop
+
+
+timeit("pallas_sweep_512", make_sweep_loop(sweep), curr, nxt, sel3)
+
+# ---- 2. pallas sweep with full self-wrap
+sweep_wrap = make_pallas_jacobi_sweep(spec, sel_z_range(spec), wrap=(True, True, True))
+timeit("pallas_sweep_512_wrap", make_sweep_loop(sweep_wrap), curr, nxt, sel3)
+
+# ---- 3. exchange alone r=1 1q
+mesh = grid_mesh(spec.dim, dev)
+ex1 = HaloExchange(spec, mesh)
+loop1 = ex1.make_loop(ITERS)
+st = {0: shard_blocks(np.zeros((N, N, N), np.float32), spec, mesh)}
+st = timeit("exchange_r1_1q", loop1, st, rebind=lambda out, a: (out,))
+
+# ---- 4. full jacobi loop (bench path)
+jl = make_jacobi_loop(ex1, ITERS, overlap=True)
+sharding = ex1.sharding()
+shape = spec.stacked_shape_zyx()
+c6 = jax.device_put(jnp.full(shape, INIT_TEMP, jnp.float32), sharding)
+n6 = jax.device_put(jnp.zeros(shape, jnp.float32), sharding)
+selb = shard_blocks(sphere_sel(Dim3(N, N, N)), spec, mesh)
+timeit("jacobi_full_step", jl, c6, n6, selb,
+       rebind=lambda out, a: (out[0], out[1], a[2]))
+
+# ---- 5. exchange r=3 4q (bench exchange path)
+spec3 = GridSpec(Dim3(N, N, N), Dim3(1, 1, 1), Radius.constant(3))
+ex3 = HaloExchange(spec3, mesh)
+loop3 = ex3.make_loop(ITERS)
+st3 = {i: shard_blocks(np.zeros((N, N, N), np.float32), spec3, mesh) for i in range(4)}
+st3 = timeit("exchange_r3_4q", loop3, st3, rebind=lambda out, a: (out,))
+print("logical GB per exchange r3 4q:", ex3.bytes_logical([4] * 4) / 1e9, flush=True)

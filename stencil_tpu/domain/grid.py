@@ -23,7 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Tuple
 
-from ..geometry import Dim3, Radius, raw_size
+from ..geometry import Dim3, Radius, Rect3, halo_rect
 
 
 def _axis_sizes(total: int, n: int, base: int) -> Tuple[int, ...]:
@@ -93,8 +93,12 @@ class GridSpec:
     # -- shapes --------------------------------------------------------------
     def padded(self) -> Dim3:
         """Per-block allocation extent (x, y, z); when ``aligned``, the y/x
-        plane dims are rounded up to TPU tile multiples (dead tail)."""
-        p = raw_size(self.base, self.radius)
+        plane dims are rounded up to TPU tile multiples (dead tail) and the
+        compute region starts at an 8-aligned y row (see compute_offset)."""
+        off = self.compute_offset()
+        r = self.radius
+        p = Dim3(off.x + self.base.x + r.x(1), off.y + self.base.y + r.y(1),
+                 off.z + self.base.z + r.z(1))
         if not self.aligned:
             return p
         return Dim3(_round_up(p.x, ALIGN_X), _round_up(p.y, ALIGN_Y), p.z)
@@ -112,4 +116,25 @@ class GridSpec:
         return self.dim.flatten()
 
     def compute_offset(self) -> Dim3:
-        return Dim3(self.radius.x(-1), self.radius.y(-1), self.radius.z(-1))
+        """Allocation-local origin of the compute region.
+
+        In ``aligned`` layouts the y (sublane) offset is rounded up to the
+        8-row tile so that HBM/VMEM DMA slices of row-tiled slabs start on
+        tile boundaries (Mosaic requires tile-aligned slice offsets in the
+        minor-two dims; z is untiled and x slabs span full rows). The rows
+        between the y halo and the compute region are dead pad."""
+        r = self.radius
+        yo = r.y(-1)
+        if self.aligned and yo > 0:
+            yo = _round_up(yo, ALIGN_Y)
+        return Dim3(r.x(-1), yo, r.z(-1))
+
+    def halo_rect(self, direction, size=None, halo: bool = True) -> Rect3:
+        """Allocation-local halo (or owned boundary) rect in *this* layout:
+        the radius-origin geometry rect (geometry.halo_rect) translated by
+        the aligned layout's extra compute offset."""
+        r = self.radius
+        sz = self.base if size is None else Dim3.of(size)
+        shift = self.compute_offset() - Dim3(r.x(-1), r.y(-1), r.z(-1))
+        rect = halo_rect(direction, sz, r, halo)
+        return Rect3(rect.lo + shift, rect.hi + shift)

@@ -76,7 +76,8 @@ def jacobi6_block(block, radius: Radius, masks=None):
     return jacobi_sweep(block, block, Rect3(off, hi), masks)
 
 
-def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None):
+def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None,
+                     standard_spheres: bool = True):
     """Build the jitted distributed iteration: exchange + stencil + swap.
 
     Returns ``step(curr, nxt, hot, cold) -> (new_curr, new_next)`` over
@@ -89,19 +90,28 @@ def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None):
     read exchanged halos. On an uneven partition the step falls back to
     exchange-then-full-sweep (slab extents would be data-dependent).
     """
-    return _compile_jacobi(ex, overlap, iters=None, use_pallas=use_pallas)
+    return _compile_jacobi(ex, overlap, iters=None, use_pallas=use_pallas,
+                           standard_spheres=standard_spheres)
 
 
-def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pallas=None):
+def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pallas=None,
+                     standard_spheres: bool = True):
     """Like :func:`make_jacobi_step` but runs ``iters`` iterations inside one
     compiled program (``lax.fori_loop``) — one host dispatch per chunk.
 
     This is the ``USE_CUDA_GRAPH`` analogue taken further: where the
     reference graph-captures one exchange (packer.cu:96-103), XLA compiles
     the whole iteration loop, which also removes the per-call host
-    round-trip of the tunneled TPU platform (~0.7 s each).
+    round-trip of the tunneled TPU platform.
+
+    ``standard_spheres`` declares that the ``sel`` argument will be the
+    standard jacobi3d hot/cold spheres (``sphere_sel(global_size)``). Only
+    then may the temporal-blocked kernel engage, because it re-derives the
+    spheres from coordinates instead of reading ``sel``. Pass ``False``
+    when driving the step with a custom or empty ``sel``.
     """
-    return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas)
+    return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas,
+                           standard_spheres=standard_spheres)
 
 
 def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
@@ -111,7 +121,8 @@ def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
     return ex.spec.aligned and all(d.platform == "tpu" for d in devs)
 
 
-def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None):
+def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
+                    standard_spheres: bool = True):
     spec = ex.spec
     r = spec.radius
     assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
@@ -124,17 +135,41 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None):
     use_overlap = overlap and spec.is_uniform()
 
     pallas_sweep = None
+    pallas_axes = None
     if _want_pallas(ex, use_pallas):
         from .pallas_stencil import make_pallas_jacobi_sweep, sel_z_range
-        from ..parallel.mesh import MESH_AXES
+        from ..parallel.mesh import AXIS_X, AXIS_Y, AXIS_Z, MESH_AXES
 
-        pallas_sweep = make_pallas_jacobi_sweep(spec, sel_z_range(spec), vma=MESH_AXES)
+        # axes with a single block are periodic onto themselves: the kernel
+        # fills those halos from the opposite face (wrap), and the exchange
+        # runs only on the multi-block axes (engages exchange_block's axis
+        # subsetting, AXIS_COMPOSED only). On one chip the exchange
+        # vanishes entirely.
+        from ..parallel.exchange import Method
+
+        if ex.method == Method.AXIS_COMPOSED:
+            wrap = (spec.dim.z == 1, spec.dim.y == 1, spec.dim.x == 1)
+            pallas_axes = tuple(
+                name for name, w in zip((AXIS_Z, AXIS_Y, AXIS_X), wrap) if not w
+            )
+        else:
+            wrap = (False, False, False)
+            pallas_axes = None  # DIRECT26 has no axis phases to subset
+        pallas_sweep = make_pallas_jacobi_sweep(
+            spec, sel_z_range(spec), vma=MESH_AXES, wrap=wrap
+        )
 
     def body(curr, nxt, sel):
         if pallas_sweep is not None:
-            # the Pallas sweep consumes exchanged halos, so the structure is
-            # exchange-then-sweep (overlap via dataflow does not apply here)
-            cur2 = ex.exchange_block(curr)
+            # the Pallas sweep consumes exchanged halos on multi-block axes,
+            # so the structure is exchange-then-sweep; self-wrap axes are
+            # handled inside the kernel
+            if pallas_axes is None:  # DIRECT26: no axis phases to subset
+                cur2 = ex.exchange_block(curr)
+            elif pallas_axes:
+                cur2 = ex.exchange_block(curr, axes=pallas_axes)
+            else:  # every axis self-wraps: no exchange at all
+                cur2 = curr
             p = spec.padded()
             out = pallas_sweep(
                 cur2.reshape(p.z, p.y, p.x),
@@ -154,7 +189,42 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None):
         # swap: computed buffer becomes curr, old curr becomes scratch
         return out, cur2
 
+    # temporal blocking: when every axis self-wraps (single block) and the
+    # loop is fused, advance TEMPORAL_K steps per HBM pass — the stencil is
+    # purely memory-bound here, so halving traffic nearly halves step time
+    multistep = None
+    TEMPORAL_K = 2
+    if (
+        pallas_sweep is not None
+        and pallas_axes == ()
+        and standard_spheres
+        and iters is not None
+        and iters >= TEMPORAL_K
+        and spec.base.z >= 2 * TEMPORAL_K + 1
+    ):
+        from .pallas_stencil import make_pallas_jacobi_multistep
+        from ..parallel.mesh import MESH_AXES
+
+        multistep = make_pallas_jacobi_multistep(spec, TEMPORAL_K, vma=MESH_AXES)
+
     def entry_fn(curr, nxt, sel):
+        if multistep is not None:
+            p = spec.padded()
+
+            def mbody(cn):
+                c, x = cn
+                out = multistep(
+                    c.reshape(p.z, p.y, p.x), x.reshape(p.z, p.y, p.x)
+                ).reshape(c.shape)
+                return (out, c)
+
+            n_multi, n_single = divmod(iters, TEMPORAL_K)
+            cn = (curr, nxt)
+            if n_multi:
+                cn = jax.lax.fori_loop(0, n_multi, lambda _, c: mbody(c), cn)
+            for _ in range(n_single):
+                cn = body(cn[0], cn[1], sel)
+            return cn
         if iters is None:
             return body(curr, nxt, sel)
         return jax.lax.fori_loop(

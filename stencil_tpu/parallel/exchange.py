@@ -65,11 +65,16 @@ class Method(enum.Enum):
 
 
 def _spec_axis(spec: GridSpec, name: str):
+    """(per-index sizes, low radius, high radius, compute offset) along one
+    axis. The offset can exceed the low radius in aligned layouts (the y
+    compute origin is rounded to the 8-row tile); the halo always sits
+    immediately adjacent to the compute region, at [offset - rm, offset)."""
+    off = spec.compute_offset()
     if name == AXIS_X:
-        return spec.sizes_x, spec.radius.x(-1), spec.radius.x(1)
+        return spec.sizes_x, spec.radius.x(-1), spec.radius.x(1), off.x
     if name == AXIS_Y:
-        return spec.sizes_y, spec.radius.y(-1), spec.radius.y(1)
-    return spec.sizes_z, spec.radius.z(-1), spec.radius.z(1)
+        return spec.sizes_y, spec.radius.y(-1), spec.radius.y(1), off.y
+    return spec.sizes_z, spec.radius.z(-1), spec.radius.z(1), off.z
 
 
 def direction_bytes(spec: GridSpec, direction, itemsize: int) -> int:
@@ -104,7 +109,7 @@ class HaloExchange:
         if method == Method.DIRECT26 and not spec.is_uniform():
             raise ValueError("Method.DIRECT26 requires a uniform partition")
         for name in (AXIS_X, AXIS_Y, AXIS_Z):
-            sizes, rm, rp = _spec_axis(spec, name)
+            sizes, rm, rp, _off = _spec_axis(spec, name)
             if min(sizes) < max(rm, rp):
                 # halos come from the adjacent block only (one neighbor per
                 # direction, like the reference's 26-message plan)
@@ -188,7 +193,7 @@ class HaloExchange:
 
     def _axis_phase(self, block, name: str, adim: int):
         spec = self.spec
-        sizes, rm, rp = _spec_axis(spec, name)
+        sizes, rm, rp, off = _spec_axis(spec, name)
         if rm == 0 and rp == 0:
             return block
         n = len(sizes)
@@ -201,14 +206,14 @@ class HaloExchange:
         bwd = [(i, (i - 1) % n) for i in range(n)]
         if rm > 0:
             # my top rm planes -> +neighbor's low-side halo
-            slab = _slice_in_dim(block, sz, rm, adim)
+            slab = _slice_in_dim(block, off + sz - rm, rm, adim)
             slab = lax.ppermute(slab, name, fwd)
-            block = _update_in_dim(block, slab, 0, adim)
+            block = _update_in_dim(block, slab, off - rm, adim)
         if rp > 0:
             # my first rp planes -> -neighbor's high-side halo
-            slab = _slice_in_dim(block, rm, rp, adim)
+            slab = _slice_in_dim(block, off, rp, adim)
             slab = lax.ppermute(slab, name, bwd)
-            block = _update_in_dim(block, slab, rm + sz, adim)
+            block = _update_in_dim(block, slab, off + sz, adim)
         return block
 
     # -- direct-26 implementation -------------------------------------------
@@ -216,7 +221,7 @@ class HaloExchange:
         spec = self.spec
         sz = spec.base  # uniform
         r = spec.radius
-        rm = spec.compute_offset()
+        off = spec.compute_offset()
         updates = []
         for d in DIRECTIONS_26:
             if r.dir(-d) == 0:
@@ -224,26 +229,26 @@ class HaloExchange:
             starts = []
             dsts = []
             shape = []
-            for ax, (dc, s, rmin, rplus, pad) in enumerate(
+            for ax, (dc, s, rmin, rplus, o) in enumerate(
                 zip(
                     (d.z, d.y, d.x),
                     (sz.z, sz.y, sz.x),
                     (r.z(-1), r.y(-1), r.x(-1)),
                     (r.z(1), r.y(1), r.x(1)),
-                    spec.block_shape_zyx(),
+                    (off.z, off.y, off.x),
                 )
             ):
                 if dc == 1:
-                    starts.append(s)  # last rmin planes of my compute
-                    dsts.append(0)  # receiver's low-side halo
+                    starts.append(o + s - rmin)  # last rmin planes of my compute
+                    dsts.append(o - rmin)  # receiver's low-side halo
                     shape.append(rmin)
                 elif dc == -1:
-                    starts.append(rmin)  # first rplus planes of my compute
-                    dsts.append(rmin + s)  # receiver's high-side halo
+                    starts.append(o)  # first rplus planes of my compute
+                    dsts.append(o + s)  # receiver's high-side halo
                     shape.append(rplus)
                 else:
-                    starts.append(rmin)
-                    dsts.append(rmin)
+                    starts.append(o)
+                    dsts.append(o)
                     shape.append(s)
             if any(e == 0 for e in shape):
                 continue

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from stencil_tpu.api import DistributedDomain
-from stencil_tpu.geometry import DIRECTIONS_26, Dim3, Radius, halo_rect
+from stencil_tpu.geometry import DIRECTIONS_26, Dim3, Radius
 from stencil_tpu.parallel import Method
 
 
@@ -48,7 +48,7 @@ def test_exchange_via_api(method):
         for d in DIRECTIONS_26:
             if spec.radius.dir(d) == 0:
                 continue
-            rect = halo_rect(d, size, spec.radius, halo=True)
+            rect = spec.halo_rect(d, size, halo=True)
             for az in range(rect.lo.z, rect.hi.z):
                 for ay in range(rect.lo.y, rect.hi.y):
                     for ax in range(rect.lo.x, rect.hi.x):

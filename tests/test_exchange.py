@@ -15,7 +15,7 @@ import numpy as np
 import pytest
 
 from stencil_tpu.domain.grid import GridSpec
-from stencil_tpu.geometry import DIRECTIONS_26, Dim3, Radius, halo_rect
+from stencil_tpu.geometry import DIRECTIONS_26, Dim3, Radius
 from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
 from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
 
@@ -45,7 +45,7 @@ def check_halos(stacked, spec: GridSpec, dirs=None):
                 for d in dirs if dirs is not None else DIRECTIONS_26:
                     if spec.radius.dir(d) == 0:
                         continue
-                    rect = halo_rect(d, size, spec.radius, halo=True)
+                    rect = spec.halo_rect(d, size, halo=True)
                     ext = rect.extent()
                     if ext.flatten() == 0:
                         continue

@@ -84,6 +84,74 @@ def test_run_executes_exact_iteration_count():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("k", [1, 2, 3])
+def test_pallas_multistep_matches_reference(k):
+    """Temporal-blocked kernel (interpret mode): k fused steps must equal
+    k applications of the numpy periodic reference, spheres included."""
+    import jax.numpy as jnp
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_multistep
+
+    size = Dim3(20, 16, 12)
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1))
+    p = spec.padded()
+    off = spec.compute_offset()
+    fn = make_pallas_jacobi_multistep(spec, k, interpret=True)
+    rng = np.random.RandomState(0)
+    curr = np.zeros((p.z, p.y, p.x), np.float32)
+    sl = (
+        slice(off.z, off.z + size.z),
+        slice(off.y, off.y + size.y),
+        slice(off.x, off.x + size.x),
+    )
+    field = rng.rand(size.z, size.y, size.x).astype(np.float32)
+    curr[sl] = field
+    got = np.asarray(
+        fn(jnp.asarray(curr), jnp.zeros((p.z, p.y, p.x), jnp.float32))
+    )
+    want = jacobi_reference(field, sphere_masks(size), k)
+    np.testing.assert_allclose(got[sl], want, rtol=3e-7, atol=1e-7)
+
+
+@pytest.mark.parametrize("tiles", [None, (5, 16)])
+def test_pallas_wrap_matches_periodic_reference(tiles, monkeypatch):
+    """Self-wrap mode (kernel fills periodic halos itself) vs np.roll
+    reference; tiles=(5,16) forces the row-tiled slab path with the
+    staged y-wrap DMA."""
+    import jax.numpy as jnp
+    import stencil_tpu.ops.pallas_stencil as ps
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+
+    size = Dim3(24, 64, 10)
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1))
+    if tiles is not None:
+        monkeypatch.setattr(ps, "_pick_tiles", lambda *a: tiles)
+    sweep = ps.make_pallas_jacobi_sweep(
+        spec, (0, 0), interpret=True, wrap=(True, True, True)
+    )
+    p = spec.padded()
+    off = spec.compute_offset()
+    rng = np.random.RandomState(1)
+    curr = jnp.asarray(rng.rand(p.z, p.y, p.x).astype(np.float32))
+    got = np.asarray(
+        sweep(curr, jnp.zeros((p.z, p.y, p.x), jnp.float32),
+              jnp.zeros((p.z, p.y, p.x), np.int32))
+    )
+    sl = (
+        slice(off.z, off.z + size.z),
+        slice(off.y, off.y + size.y),
+        slice(off.x, off.x + size.x),
+    )
+    f = np.asarray(curr)[sl].astype(np.float64)
+    want = (
+        np.roll(f, 1, 2) + np.roll(f, -1, 2) + np.roll(f, 1, 1)
+        + np.roll(f, -1, 1) + np.roll(f, 1, 0) + np.roll(f, -1, 0)
+    ) / 6
+    np.testing.assert_allclose(got[sl], want, rtol=3e-7, atol=1e-7)
+
+
 def test_pallas_sweep_matches_xla_interpret():
     """Pallas kernel (interpret mode) computes exactly what the XLA path
     computes over the compute region, including sphere overrides."""
@@ -97,23 +165,23 @@ def test_pallas_sweep_matches_xla_interpret():
     spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1))
     sweep = make_pallas_jacobi_sweep(spec, sel_z_range(spec), interpret=True)
     p = spec.padded()
+    off = spec.compute_offset()
     rng = np.random.RandomState(0)
     curr = jnp.asarray(rng.rand(p.z, p.y, p.x).astype(np.float32))
     nxt = jnp.zeros((p.z, p.y, p.x), jnp.float32)
     selg = sphere_sel(size)
     sel = np.zeros((p.z, p.y, p.x), np.int32)
-    sel[1 : 1 + size.z, 1 : 1 + size.y, 1 : 1 + size.x] = selg
+    cz = slice(off.z, off.z + size.z)
+    cy = slice(off.y, off.y + size.y)
+    cx = slice(off.x, off.x + size.x)
+    sel[cz, cy, cx] = selg
     got = np.asarray(sweep(curr, nxt, jnp.asarray(sel)))
 
-    off = spec.compute_offset()
     rect = Rect3(off, off + spec.base)
     sel_j = jnp.asarray(sel)
     want = np.asarray(
         jacobi_sweep(curr, jnp.zeros_like(nxt), rect, (sel_j == 1, sel_j == 2))
     )
-    cz = slice(1, 1 + size.z)
-    cy = slice(1, 1 + size.y)
-    cx = slice(1, 1 + size.x)
     # the two lowerings may reassociate differently -> ULP-level tolerance
     np.testing.assert_allclose(got[cz, cy, cx], want[cz, cy, cx], rtol=3e-7, atol=1e-7)
     assert (sel[cz, cy, cx] == 1).any()  # spheres actually exercised

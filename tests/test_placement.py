@@ -141,8 +141,9 @@ def test_placements_through_api(placement):
     np.testing.assert_array_equal(dd.get_curr_global(h), field)
     # spot-check one wrapped halo cell on block (0,0,0)
     arr = np.asarray(jax.device_get(dd.get_curr(h)))[0, 0, 0]
-    # -x halo at allocation (1+dz..., y=1.., x=0) maps to global x=7 wrap
-    assert arr[1, 1, 0] == field[0, 0, 7]
+    off = dd.spec.compute_offset()
+    # -x halo at the compute origin row/plane maps to global x=7 wrap
+    assert arr[off.z, off.y, off.x - 1] == field[0, 0, 7]
 
 
 def test_intranode_random_deterministic():
