@@ -191,11 +191,42 @@ class HaloExchange:
             block = self._axis_phase(block, name, adim)
         return block
 
+    @cached_property
+    def _self_fills(self):
+        """axis name -> in-place Pallas halo-fill kernel, for single-block
+        (self-wrap) axes on TPU (the pack/unpack-kernel analogue; see
+        ops/halo_fill.py). Empty off-TPU or for unsupported layouts."""
+        devs = self.mesh.devices.flatten()
+        if not all(d.platform == "tpu" for d in devs) or not self.spec.aligned:
+            return {}
+        import jax.numpy as jnp
+
+        from ..ops.halo_fill import make_self_fill, self_fill_supported
+        from .mesh import MESH_AXES
+
+        fills = {}
+        for name in (AXIS_X, AXIS_Y, AXIS_Z):
+            sizes, _rm, _rp, _o = _spec_axis(self.spec, name)
+            if len(sizes) == 1 and self_fill_supported(self.spec, name, jnp.float32):
+                fills[name] = make_self_fill(self.spec, name, vma=MESH_AXES)
+        return fills
+
     def _axis_phase(self, block, name: str, adim: int):
         spec = self.spec
         sizes, rm, rp, off = _spec_axis(spec, name)
         if rm == 0 and rp == 0:
             return block
+        if (
+            len(sizes) == 1
+            and block.dtype == jnp.float32
+            and name in self._self_fills
+        ):
+            # self-wrap axis: fill halos in place, touching only the edge
+            # tiles, instead of materializing slabs + whole-array updates
+            p = spec.padded()
+            return self._self_fills[name](block.reshape(p.z, p.y, p.x)).reshape(
+                block.shape
+            )
         n = len(sizes)
         uniform = len(set(sizes)) == 1
         if uniform:
@@ -207,12 +238,14 @@ class HaloExchange:
         if rm > 0:
             # my top rm planes -> +neighbor's low-side halo
             slab = _slice_in_dim(block, off + sz - rm, rm, adim)
-            slab = lax.ppermute(slab, name, fwd)
+            if n > 1:  # n == 1 wraps onto itself; the permute is an identity
+                slab = lax.ppermute(slab, name, fwd)
             block = _update_in_dim(block, slab, off - rm, adim)
         if rp > 0:
             # my first rp planes -> -neighbor's high-side halo
             slab = _slice_in_dim(block, off, rp, adim)
-            slab = lax.ppermute(slab, name, bwd)
+            if n > 1:
+                slab = lax.ppermute(slab, name, bwd)
             block = _update_in_dim(block, slab, off + sz, adim)
         return block
 

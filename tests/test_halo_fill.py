@@ -1,0 +1,51 @@
+"""In-place self-wrap halo-fill kernels (interpret mode) vs direct numpy
+slab placement — the pack/unpack-kernel correctness check (reference idiom:
+test_cuda_pack.cu round-trips)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.ops.halo_fill import make_self_fill, self_fill_supported
+
+
+@pytest.mark.parametrize("size,r", [((256, 136, 24), 1), ((140, 160, 40), 2), ((256, 144, 30), 3)])
+@pytest.mark.parametrize("axis", ["x", "y", "z"])
+def test_self_fill_matches_numpy(size, r, axis):
+    sx, sy, sz = size
+    spec = GridSpec(Dim3(sx, sy, sz), Dim3(1, 1, 1), Radius.constant(r))
+    assert self_fill_supported(spec, axis, jnp.float32)
+    p = spec.padded()
+    o = spec.compute_offset()
+    rng = np.random.RandomState(0)
+    base = rng.rand(p.z, p.y, p.x).astype(np.float32)
+    fill = make_self_fill(spec, axis, interpret=True)
+    got = np.asarray(fill(jnp.asarray(base)))
+    want = base.copy()
+    if axis == "z":
+        want[o.z - r : o.z] = base[o.z + sz - r : o.z + sz]
+        want[o.z + sz : o.z + sz + r] = base[o.z : o.z + r]
+    elif axis == "y":
+        want[:, o.y - r : o.y, :] = base[:, o.y + sy - r : o.y + sy, :]
+        want[:, o.y + sy : o.y + sy + r, :] = base[:, o.y : o.y + r, :]
+    else:
+        want[:, :, o.x - r : o.x] = base[:, :, o.x + sx - r : o.x + sx]
+        want[:, :, o.x + sx : o.x + sx + r] = base[:, :, o.x : o.x + r]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_self_fill_gates():
+    # float64 and unaligned layouts must fall back
+    spec = GridSpec(Dim3(64, 64, 16), Dim3(1, 1, 1), Radius.constant(1))
+    assert not self_fill_supported(spec, "x", jnp.float64)
+    spec_u = GridSpec(Dim3(64, 64, 16), Dim3(1, 1, 1), Radius.constant(1), aligned=False)
+    assert not self_fill_supported(spec_u, "x", jnp.float32)
+    # zero radius on the axis: nothing to fill
+    r = Radius.constant(0)
+    r.set_face("x", -1, 1)
+    r.set_face("x", 1, 1)
+    spec_x = GridSpec(Dim3(64, 64, 16), Dim3(1, 1, 1), r)
+    assert not self_fill_supported(spec_x, "y", jnp.float32)
