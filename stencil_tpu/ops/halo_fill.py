@@ -50,6 +50,26 @@ def _axis_geom(spec: GridSpec, axis: str) -> Tuple[int, int, int]:
     return off.z, spec.base.z, (r.z(-1), r.z(1))
 
 
+# VMEM scratch budget for a fill kernel; v5e has ~16 MB more-or-less free,
+# leave headroom for Mosaic's own allocations.
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _scratch_bytes(spec: GridSpec, axis: str) -> int:
+    """VMEM scratch the kernel for ``axis`` would allocate (see make_self_fill)."""
+    p = spec.padded()
+    o, sz, (rm, rp) = _axis_geom(spec, axis)
+    if axis == "z":
+        return max(rm, rp, 1) * p.y * p.x * 4
+    if axis == "y":
+        spans = []
+        for a, b in ((o - rm, o), (o + sz, o + sz + rp), (o, o + rp), (o + sz - rm, o + sz)):
+            t = (a // _SUB) * _SUB
+            spans.append(-(-(b - t) // _SUB) * _SUB)
+        return 2 * 8 * max(spans) * p.x * 4
+    return 8 * 4 * p.y * _LANE * 4  # x: 4 double-buffered (2, 4, py, 128) buffers
+
+
 def self_fill_supported(spec: GridSpec, axis: str, dtype) -> bool:
     """Whether the in-place fill kernel handles this configuration."""
     if not spec.aligned or dtype != jnp.float32:
@@ -57,12 +77,20 @@ def self_fill_supported(spec: GridSpec, axis: str, dtype) -> bool:
     o, sz, (rm, rp) = _axis_geom(spec, axis)
     if rm == 0 and rp == 0:
         return False
+    p = spec.padded()
+    # x/y kernels stream fixed-depth z batches; thinner blocks would slice
+    # out of range (z0 = min(i*TZB, pz-TZB) goes negative)
+    if axis == "x" and p.z < 4:
+        return False
+    if axis == "y" and p.z < 8:
+        return False
+    if _scratch_bytes(spec, axis) > _VMEM_BUDGET:
+        return False
     if axis == "x":
         # halo and wrap-source columns must each sit inside the two edge
         # lane-tiles the kernel rewrites
         lo_t = 0
         hi_t = ((o + sz) // _LANE) * _LANE
-        p = spec.padded()
         if hi_t + _LANE > p.x or hi_t <= lo_t:
             return False
         cols = [(o - rm, o), (o, o + rp), (o + sz - rm, o + sz), (o + sz, o + sz + rp)]

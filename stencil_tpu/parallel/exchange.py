@@ -171,8 +171,12 @@ class HaloExchange:
         return per_item * sum(itemsizes)
 
     def bytes_moved(self, itemsizes: Sequence[int]) -> int:
-        """Bytes actually carried by collectives (composed slabs span full
-        padded extents, so this is >= bytes_logical)."""
+        """Bytes relocated by the exchange implementation: composed slabs
+        span full padded extents, so this is >= bytes_logical. On a
+        self-wrap (single-block) axis no collective carries data — the same
+        slab bytes move in place, via the Pallas fill kernel on TPU (whose
+        x/y lane/row-tile RMW amplification is not counted here) or via
+        slice+update elsewhere."""
         p = self.spec.padded()
         if self.method == Method.DIRECT26:
             return self.bytes_logical(itemsizes)
@@ -197,10 +201,8 @@ class HaloExchange:
         (self-wrap) axes on TPU (the pack/unpack-kernel analogue; see
         ops/halo_fill.py). Empty off-TPU or for unsupported layouts."""
         devs = self.mesh.devices.flatten()
-        if not all(d.platform == "tpu" for d in devs) or not self.spec.aligned:
+        if not all(d.platform == "tpu" for d in devs):
             return {}
-        import jax.numpy as jnp
-
         from ..ops.halo_fill import make_self_fill, self_fill_supported
         from .mesh import MESH_AXES
 

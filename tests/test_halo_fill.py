@@ -45,7 +45,28 @@ def test_self_fill_gates():
     assert not self_fill_supported(spec_u, "x", jnp.float32)
     # zero radius on the axis: nothing to fill
     r = Radius.constant(0)
-    r.set_face("x", -1, 1)
-    r.set_face("x", 1, 1)
+    r.set_dir((-1, 0, 0), 1)
+    r.set_dir((1, 0, 0), 1)
     spec_x = GridSpec(Dim3(64, 64, 16), Dim3(1, 1, 1), r)
     assert not self_fill_supported(spec_x, "y", jnp.float32)
+
+
+def test_self_fill_gates_thin_z():
+    # x (TZB=4) and y (TZB=8) kernels stream fixed-depth z batches; blocks
+    # thinner than one batch must fall back (z0 would go negative)
+    spec = GridSpec(Dim3(128, 64, 4), Dim3(1, 1, 1), Radius.constant(1))
+    assert spec.padded().z < 8
+    assert not self_fill_supported(spec, "y", jnp.float32)
+    thin = GridSpec(Dim3(128, 64, 2), Dim3(1, 1, 1), Radius.constant(1))
+    if thin.padded().z < 4:
+        assert not self_fill_supported(thin, "x", jnp.float32)
+    # z kernel copies whole planes regardless of depth
+    assert self_fill_supported(spec, "z", jnp.float32)
+
+
+def test_self_fill_gates_vmem_budget():
+    # huge planes exceed the VMEM scratch budget; must fall back instead of
+    # failing Mosaic compilation inside HaloExchange
+    spec = GridSpec(Dim3(2048, 2048, 64), Dim3(1, 1, 1), Radius.constant(3))
+    assert not self_fill_supported(spec, "z", jnp.float32)  # r*py*px*4 ~ 50 MB
+    assert not self_fill_supported(spec, "x", jnp.float32)  # 8*4*py*128*4 ~ 33 MB
