@@ -184,14 +184,42 @@ class DistributedDomain:
         return self._exchange.sharding()
 
     # -- the iteration API (reference: stencil.hpp:182-215) ------------------
+    @property
+    def halo_exchange(self) -> HaloExchange:
+        """The compiled halo-exchange op, for composing into larger jitted
+        steps (fused compute/exchange overlap, custom loops). Public: this
+        is how apps embed the exchange inside their own shard_map'd step
+        (the reference's equivalent is handing its senders the app streams,
+        bin/jacobi3d.cu:296-368)."""
+        return self._exchange
+
     def exchange(self) -> None:
         """Fill every halo from the periodic neighbors
-        (reference: src/stencil.cu:1002-1186)."""
+        (reference: src/stencil.cu:1002-1186).
+
+        Synchronizes with the device each call, so the per-call overhead is
+        a full host round-trip (~0.7 s on a tunneled TPU). For iteration
+        loops use :meth:`exchange_loop` / :attr:`halo_exchange` instead."""
         t0 = time.perf_counter()
         self._curr = self._exchange(self._curr)
         hard_sync(self._curr)  # block_until_ready lies on the tunneled TPU
         self.time_exchange += time.perf_counter() - t0
         self.num_exchanges += 1
+
+    def exchange_loop(self, iters: int):
+        """``iters`` fused back-to-back exchanges as one compiled program
+        acting on a quantity pytree (see :meth:`curr_state`): amortizes
+        dispatch cost the way the reference's timed loops amortize launch
+        overhead (reference: bin/exchange_weak.cu:168-177)."""
+        return self._exchange.make_loop(iters)
+
+    def run_exchanges(self, iters: int) -> None:
+        """Run ``iters`` fused exchanges on the domain's current state."""
+        t0 = time.perf_counter()
+        self._curr = self.exchange_loop(iters)(self._curr)
+        hard_sync(self._curr)
+        self.time_exchange += time.perf_counter() - t0
+        self.num_exchanges += iters
 
     def swap(self) -> None:
         """Swap curr/next (reference: src/stencil.cu:852-872)."""

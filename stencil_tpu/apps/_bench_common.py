@@ -58,9 +58,9 @@ def time_exchange(
     state = dd.curr_state()
     chunk = max(1, min(chunk, iters))
     tail = iters % chunk
-    loops = {chunk: dd._exchange.make_loop(chunk)}
+    loops = {chunk: dd.halo_exchange.make_loop(chunk)}
     if tail:
-        loops[tail] = dd._exchange.make_loop(tail)
+        loops[tail] = dd.halo_exchange.make_loop(tail)
     # compile + warm every loop size OUTSIDE the timed region
     for fn in loops.values():
         state = fn(state)
@@ -82,9 +82,9 @@ def time_exchange(
         "stats": stats,
         "trimean_s": stats.trimean(),
         "min_s": stats.min(),
-        "bytes_logical": dd._exchange.bytes_logical(itemsizes),
-        "bytes_moved": dd._exchange.bytes_moved(itemsizes),
-        "gb_per_s": dd._exchange.bytes_logical(itemsizes) / stats.trimean() / 1e9,
+        "bytes_logical": dd.halo_exchange.bytes_logical(itemsizes),
+        "bytes_moved": dd.halo_exchange.bytes_moved(itemsizes),
+        "gb_per_s": dd.halo_exchange.bytes_logical(itemsizes) / stats.trimean() / 1e9,
         "local_size": dd.spec.base,
         "devices": len(devices),
     }

@@ -123,7 +123,7 @@ def run(
     exch_time = Statistics()
     if no_compute:
         # measure pure exchange per substep (reference --no-compute flag)
-        loop = dd._exchange.make_loop(3)
+        loop = dd.halo_exchange.make_loop(3)
         curr = loop(curr)
         hard_sync(curr)
         for _ in range(iters):
@@ -135,7 +135,7 @@ def run(
             exch_time.insert(dt_iter)
     else:
         step = make_astaroth_step(
-            dd._exchange,
+            dd.halo_exchange,
             info,
             dt=dt,
             overlap=overlap,
@@ -148,7 +148,7 @@ def run(
         # iteration (halo exchange is idempotent on exchanged data, so this
         # does not perturb the fields) — the analogue of the reference's
         # exchElapsed within the iteration (astaroth.cu:586-590).
-        exch_loop = dd._exchange.make_loop(3)
+        exch_loop = dd.halo_exchange.make_loop(3)
         curr = exch_loop(curr)
         hard_sync(curr)
 
@@ -184,7 +184,7 @@ def run(
         "info": info,
     }
     if reductions:
-        red = Reductions(dd._exchange)
+        red = Reductions(dd.halo_exchange)
         result["reductions"] = {
             "lnrho": red.scal(dd.get_curr(handles["lnrho"])),
             "uu": red.vec(

@@ -95,9 +95,9 @@ def run(
     def get_loop(k: int):
         if k not in loops:
             loops[k] = (
-                make_jacobi_loop(dd._exchange, k, overlap=overlap)
+                make_jacobi_loop(dd.halo_exchange, k, overlap=overlap)
                 if k > 1
-                else make_jacobi_step(dd._exchange, overlap=overlap)
+                else make_jacobi_step(dd.halo_exchange, overlap=overlap)
             )
         return loops[k]
 
