@@ -28,7 +28,7 @@ import jax
 from ..api import DistributedDomain
 from ..astaroth.config import load_config
 from ..astaroth.init import const_init, hash_init, radial_explosion_init
-from ..astaroth.integrate import FIELDS, make_astaroth_step
+from ..astaroth.integrate import FIELDS, make_astaroth_step, uses_pallas
 from ..astaroth.reductions import Reductions
 from ..geometry import Dim3, prime_factors
 from ..parallel import Method
@@ -69,6 +69,8 @@ def run(
     swap_per_substep: bool = False,
     reductions: bool = False,
     dt: float = 1e-8,
+    use_pallas=None,
+    chunk: int = 1,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     info, ok = load_config(conf)
@@ -134,29 +136,41 @@ def run(
             iter_time.insert(dt_iter)
             exch_time.insert(dt_iter)
     else:
+        chunk = max(1, min(chunk, iters))
         step = make_astaroth_step(
             dd.halo_exchange,
             info,
             dt=dt,
             overlap=overlap,
             swap_per_substep=swap_per_substep,
+            use_pallas=use_pallas,
+            dtype=dtype,
+            iters=chunk,
         )
-        curr, nxt = step(curr, nxt)  # compile + warm (one iteration)
+        curr, nxt = step(curr, nxt)  # compile + warm (one chunk)
         hard_sync(curr)
         # The exchange share can't be timed inside the fused step, so it is
-        # measured as a standalone 3-exchange loop on the same state each
-        # iteration (halo exchange is idempotent on exchanged data, so this
-        # does not perturb the fields) — the analogue of the reference's
-        # exchElapsed within the iteration (astaroth.cu:586-590).
-        exch_loop = dd.halo_exchange.make_loop(3)
+        # measured as a standalone loop on the same state each iteration
+        # (halo exchange is idempotent on exchanged data, so this does not
+        # perturb the fields) — the analogue of the reference's exchElapsed
+        # within the iteration (astaroth.cu:586-590). The loop length
+        # mirrors the step's exchanges per iteration: 3 (one per substep)
+        # on the XLA path, 1 on the fused Pallas path (non-swap mode).
+        pallas_on = uses_pallas(dd.halo_exchange, use_pallas, dtype)
+        n_ex = 1 if (pallas_on and not swap_per_substep) else 3
+        exch_loop = dd.halo_exchange.make_loop(n_ex)
         curr = exch_loop(curr)
         hard_sync(curr)
 
-        for _ in range(iters):
+        done = 0
+        while done < iters:
             t0 = time.perf_counter()
             curr, nxt = step(curr, nxt)
             hard_sync(curr)
-            iter_time.insert(time.perf_counter() - t0)
+            per = (time.perf_counter() - t0) / chunk
+            for _ in range(chunk):
+                iter_time.insert(per)
+            done += chunk
             t0 = time.perf_counter()
             curr = exch_loop(curr)
             hard_sync(curr)
@@ -216,6 +230,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--paraview-final", action="store_true")
     p.add_argument("--f32", action="store_true", help="float32 fields (TPU-native)")
     p.add_argument("--reductions", action="store_true", help="print field reductions")
+    p.add_argument("--no-pallas", action="store_true",
+                   help="force the unfused XLA substep path")
+    p.add_argument("--chunk", type=int, default=1,
+                   help="iterations fused per dispatch (benchmarking; a "
+                        "final partial chunk still runs a full chunk)")
     p.add_argument("--cpu", type=int, default=0)
     args = p.parse_args(argv)
     if args.cpu:
@@ -235,6 +254,8 @@ def main(argv: Optional[list] = None) -> int:
         paraview_init=args.paraview_init,
         paraview_final=args.paraview_final,
         reductions=args.reductions,
+        use_pallas=False if args.no_pallas else None,
+        chunk=args.chunk,
     )
     print(csv_row(r))
     if "reductions" in r:

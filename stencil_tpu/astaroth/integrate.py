@@ -95,6 +95,24 @@ def _integrate_region(
     return new_out
 
 
+def uses_pallas(ex: HaloExchange, use_pallas, dtype="float32") -> bool:
+    """Whether :func:`make_astaroth_step` will take the fused Pallas path
+    for fields of ``dtype`` (None = auto: TPU, fp32, uniform aligned
+    blocks)."""
+    if use_pallas is not None:
+        return bool(use_pallas)
+    import jax.numpy as jnp
+
+    from ..ops.pallas_astaroth import substep_supported
+
+    devs = ex.mesh.devices.flatten()
+    return (
+        all(d.platform == "tpu" for d in devs)
+        and ex.spec.is_uniform()
+        and substep_supported(ex.spec, jnp.dtype(dtype))
+    )
+
+
 def make_astaroth_step(
     ex: HaloExchange,
     info: AcMeshInfo,
@@ -102,11 +120,22 @@ def make_astaroth_step(
     overlap: bool = True,
     swap_per_substep: bool = False,
     iters: int = 1,
+    use_pallas=None,
+    dtype="float32",
 ):
     """Build the jitted iteration: ``fn(curr, nxt) -> (curr, nxt)`` where
     curr/nxt are dicts of stacked sharded field arrays. Runs ``iters``
     iterations of 3 substeps in one compiled program; the dt=1e-8 default
-    matches the reference driver (astaroth.cu:578)."""
+    matches the reference driver (astaroth.cu:578).
+
+    ``use_pallas`` (None = auto, see :func:`uses_pallas`; ``dtype`` is the
+    field dtype the step will be driven with) selects the fused VMEM
+    substep kernel (ops/pallas_astaroth.py). The Pallas path exchanges
+    once per iteration — legitimate because the in buffers do not change
+    between substeps in reference swap-per-iteration mode, and
+    re-exchanged before every substep in swap_per_substep mode — and runs
+    exchange-then-compute (no interior/exterior split; the fused kernel's
+    whole-region pass is faster than the split was)."""
     spec = ex.spec
     r = spec.radius
     assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
@@ -124,26 +153,58 @@ def make_astaroth_step(
     exteriors = exterior_regions(compute, interior)
     use_overlap = overlap and spec.is_uniform()
 
-    def substep_block(substep, curr, out):
-        if use_overlap:
-            out = _integrate_region(substep, interior, inv_ds, c, dt, curr, out)
-            curr = {k: ex.exchange_block(v) for k, v in curr.items()}
-            for rect in exteriors:
-                out = _integrate_region(substep, rect, inv_ds, c, dt, curr, out)
-        else:
-            curr = {k: ex.exchange_block(v) for k, v in curr.items()}
-            out = _integrate_region(substep, compute, inv_ds, c, dt, curr, out)
-        return curr, out
+    if uses_pallas(ex, use_pallas, dtype):
+        from ..ops.pallas_astaroth import make_pallas_substep
+        from ..parallel.mesh import MESH_AXES
 
-    def iteration(curr, out):
-        for substep in range(3):
-            curr, out = substep_block(substep, curr, out)
+        kernels = [
+            make_pallas_substep(spec, c, inv_ds, s, dt, vma=MESH_AXES)
+            for s in range(3)
+        ]
+        p = spec.padded()
+
+        def to3(d):
+            return tuple(d[k].reshape(p.z, p.y, p.x) for k in FIELDS)
+
+        def untuple(vals, like):
+            return {k: v.reshape(like[k].shape) for k, v in zip(FIELDS, vals)}
+
+        def exchange_all(curr):
+            return {k: ex.exchange_block(v) for k, v in curr.items()}
+
+        def iteration(curr, out):
             if swap_per_substep:
+                for s in range(3):
+                    curr = exchange_all(curr)
+                    out = untuple(kernels[s](to3(curr), to3(out)), out)
+                    curr, out = out, curr
+                return curr, out
+            curr = exchange_all(curr)
+            for s in range(3):
+                out = untuple(kernels[s](to3(curr), to3(out)), out)
+            return out, curr  # one swap per iteration (astaroth.cu:642-648)
+
+    else:
+        def substep_block(substep, curr, out):
+            if use_overlap:
+                out = _integrate_region(substep, interior, inv_ds, c, dt, curr, out)
+                curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+                for rect in exteriors:
+                    out = _integrate_region(substep, rect, inv_ds, c, dt, curr, out)
+            else:
+                curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+                out = _integrate_region(substep, compute, inv_ds, c, dt, curr, out)
+            return curr, out
+
+        def iteration(curr, out):
+            for substep in range(3):
+                curr, out = substep_block(substep, curr, out)
+                if swap_per_substep:
+                    curr, out = out, curr
+            if not swap_per_substep:
+                # reference workload: one swap per iteration (astaroth.cu:642-648)
                 curr, out = out, curr
-        if not swap_per_substep:
-            # reference workload: one swap per iteration (astaroth.cu:642-648)
-            curr, out = out, curr
-        return curr, out
+            return curr, out
 
     def entry_fn(curr, out):
         if iters == 1:

@@ -1,0 +1,307 @@
+"""Pallas TPU kernel for the Astaroth RK3 substep (all 8 fields).
+
+XLA's codegen for the unfused substep materializes the shifted-slice
+operands of 60+ derivative pencils in HBM (measured ~266 ms per 256^3 fp32
+substep triple on v5e, vs a ~5 GB/substep traffic roofline of ~6 ms). This
+kernel streams (tz, ty)-row slabs of all 8 fields HBM->VMEM with
+double-buffered DMA (the pipeline structure of ops/pallas_stencil.py),
+evaluates every derivative and the four MHD right-hand sides entirely in
+VMEM, applies the Williamson RK3 stage update, and streams finished tiles
+back.
+
+The math is NOT duplicated: derivative pencils come from
+``astaroth.fd.field_data`` and the physics from ``astaroth.equations`` —
+the same functions the XLA path executes — applied to VMEM refs through a
+slab-local view adapter. Parity between the two paths is therefore
+structural (pinned by tests/test_pallas_astaroth.py in interpret mode).
+
+Layout contract: padded fp32 blocks with TPU-aligned planes
+(GridSpec(aligned=True)), face radii >= 3, exchanged halos (including the
+xy/yz/xz edge halos the cross-derivatives read — AXIS_COMPOSED phase
+composition provides them). The kernel writes compute rows only: out's
+x-halo columns in written rows carry the curr value (refreshed by the next
+exchange before any read), y/z halo rows/planes keep their prior contents.
+
+Buffering: ``in_v`` is double-buffered (tile t+1's field slabs load during
+tile t's compute). ``out_v`` is TRIPLE-buffered because three parties touch
+a slot: the out-read DMA of tile t (prefetched at t-1, substep > 0), the
+compute of tile t, and the write-back of tile t which drains while tiles
+t+1/t+2 proceed; slot t%3 is safe to reload once the write-back of tile
+t-3 has drained (waited in the prefetch path).
+
+Reference parity: the fused integrate of astaroth/kernels.cu:62-87
+(``solve<step>`` over the full subdomain) with the block-size autotuning of
+astaroth/integration.cuh:130-215 replaced by the VMEM-budget tile pick.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..domain.grid import GridSpec
+from ..geometry import Rect3, Dim3
+from ..astaroth.fd import field_data
+from ..astaroth.equations import Constants, continuity, entropy, induction, momentum
+
+FIELDS = ("lnrho", "uux", "uuy", "uuz", "ax", "ay", "az", "entropy")
+NF = len(FIELDS)
+
+# Williamson (1980) low-storage coefficients (reference: integration.cuh:19-21)
+RK3_ALPHA = (0.0, -5.0 / 9.0, -153.0 / 128.0)
+RK3_BETA = (1.0 / 3.0, 15.0 / 16.0, 8.0 / 15.0)
+
+# VMEM budget for the explicit scratch buffers (v5e-measured: ~34 MB of
+# scratch still compiles, ~45 MB does not once Mosaic's expression
+# temporaries for the tile DAG are added; 22 MB leaves solid headroom).
+_SCRATCH_BUDGET = 22 * 1024 * 1024
+_HALO = 3  # 6th-order stencils, fixed (reference: astaroth.h STENCIL_ORDER 6)
+
+
+def _divisors(n: int, cands) -> list:
+    return [c for c in cands if c <= n and n % c == 0]
+
+
+def pick_tiles(spec: GridSpec) -> Tuple[int, int]:
+    """(tz, ty) under the scratch budget (the autotuner analogue,
+    integration.cuh:130-215). Wide-y tiles measured fastest on v5e (the
+    derivative pencils' sublane rotates amortize over more rows):
+    256^3 sweep gave (2,64) 18.3 ms vs (4,8) 25.6 ms per substep — so the
+    key prefers the largest ty, then the smallest slab read
+    amplification."""
+    p = spec.padded()
+    nz, ny = spec.base.z, spec.base.y
+    best = None
+    for tz in _divisors(nz, (16, 12, 8, 6, 4, 3, 2, 1)):
+        for ty in _divisors(ny, (64, 48, 32, 24, 16, 8)):
+            in_bytes = 2 * NF * (tz + 2 * _HALO) * (ty + 16) * p.x * 4
+            out_bytes = 3 * NF * tz * ty * p.x * 4
+            if in_bytes + out_bytes > _SCRATCH_BUDGET:
+                continue
+            amp = ((tz + 2 * _HALO) * (ty + 16)) / (tz * ty)
+            key = (-min(ty, 64), amp, -(tz * ty))
+            if best is None or key < best[0]:
+                best = (key, (tz, ty))
+    return best[1] if best else (0, 0)
+
+
+def substep_supported(spec: GridSpec, dtype) -> bool:
+    """Whether the fused kernel handles this block layout."""
+    if not spec.aligned or dtype != jnp.float32:
+        return False
+    r = spec.radius
+    if min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) < _HALO:
+        return False
+    o = spec.compute_offset()
+    p = spec.padded()
+    b = spec.base
+    if b.y % 8 or o.y % 8 or o.y < 8 or o.y + b.y + 8 > p.y:
+        return False
+    if o.z < _HALO or o.z + b.z + _HALO > p.z:
+        return False
+    if o.x < _HALO or o.x + b.x + _HALO > p.x:
+        return False
+    return pick_tiles(spec) != (0, 0)
+
+
+class _SlabView:
+    """Adapter letting fd.field_data slice a (slot, field) slab of the VMEM
+    scratch ref as if it were a plain [z, y, x] array."""
+
+    __slots__ = ("ref", "pre")
+
+    def __init__(self, ref, pre):
+        self.ref = ref
+        self.pre = pre
+
+    def __getitem__(self, idx):
+        assert isinstance(idx, tuple) and idx[0] is Ellipsis, idx
+        return self.ref[self.pre + idx[1:]]
+
+
+def make_pallas_substep(
+    spec: GridSpec,
+    c: Constants,
+    inv_ds: Sequence[float],
+    substep: int,
+    dt: float,
+    interpret: bool = False,
+    vma=None,
+    tiles: Tuple[int, int] = None,
+):
+    """Build ``fn(curr8, out8) -> out8`` over padded (pz, py, px) fp32
+    blocks: one RK3 stage for all fields, out buffers updated in place.
+
+    ``curr8``/``out8`` are tuples ordered like :data:`FIELDS`."""
+    assert substep_supported(spec, jnp.float32)
+    p = spec.padded()
+    pz, py, px = p.z, p.y, p.x
+    off = spec.compute_offset()
+    zo, yo, xo = off.z, off.y, off.x
+    nz, ny, nx = spec.base.z, spec.base.y, spec.base.x
+    tz, ty = tiles if tiles is not None else pick_tiles(spec)
+    assert tz >= 1 and nz % tz == 0 and ny % ty == 0 and ty % 8 == 0, (tz, ty)
+    n_tz, n_ty = nz // tz, ny // ty
+    n_tiles = n_tz * n_ty
+    rows_in = ty + 16  # y window [y0-8, y0+ty+8): +-3 halo rows, 8-aligned
+    H = _HALO
+    beta = RK3_BETA[substep]
+    alpha_over_pb = RK3_ALPHA[substep] / RK3_BETA[substep - 1] if substep else 0.0
+    ids = tuple(float(v) for v in inv_ds)
+    # slab-local region the rates are produced over
+    rect = Rect3(Dim3(xo, 8, H), Dim3(xo + nx, 8 + ty, H + tz))
+    xs = slice(xo, xo + nx)
+
+    def kernel(*refs):
+        curr_hbm = refs[:NF]
+        oin_hbm = refs[NF : 2 * NF]
+        out_hbm = refs[2 * NF : 3 * NF]
+        in_v, out_v, s_in, s_oin, s_out = refs[3 * NF :]
+        t = pl.program_id(0)
+        slot = t % 2  # in_v slot
+        s3 = t % 3  # out_v slot
+        n3 = (t + 1) % 3
+
+        def tile_zy(ti):
+            return zo + (ti // n_ty) * tz, yo + (ti % n_ty) * ty
+
+        def in_dma(s, ti, f):
+            z0, y0 = tile_zy(ti)
+            return pltpu.make_async_copy(
+                curr_hbm[f].at[pl.ds(z0 - H, tz + 2 * H), pl.ds(y0 - 8, rows_in)],
+                in_v.at[s, f],
+                s_in.at[s],
+            )
+
+        def oin_dma(s, ti, f):
+            z0, y0 = tile_zy(ti)
+            return pltpu.make_async_copy(
+                oin_hbm[f].at[pl.ds(z0, tz), pl.ds(y0, ty)],
+                out_v.at[s, f],
+                s_oin.at[s],
+            )
+
+        def out_dma(s, ti, f):
+            z0, y0 = tile_zy(ti)
+            return pltpu.make_async_copy(
+                out_v.at[s, f],
+                out_hbm[f].at[pl.ds(z0, tz), pl.ds(y0, ty)],
+                s_out.at[s],
+            )
+
+        def start_in(s, ti):
+            for f in range(NF):
+                in_dma(s, ti, f).start()
+
+        def start_oin(s, ti):
+            if substep:
+                for f in range(NF):
+                    oin_dma(s, ti, f).start()
+
+        # pipeline: tile t+1's loads overlap tile t's compute
+        @pl.when(t == 0)
+        def _():
+            start_in(slot, t)
+            start_oin(s3, t)
+
+        @pl.when(t + 1 < n_tiles)
+        def _():
+            start_in((t + 1) % 2, t + 1)
+            if substep:
+                # out_v[(t+1)%3] was the write-back source of tile t-2
+                # ((t+1) - 3); that store must drain before reloading
+                @pl.when(t >= 2)
+                def _():
+                    for f in range(NF):
+                        out_dma(n3, t - 2, f).wait()
+
+                for f in range(NF):
+                    oin_dma(n3, t + 1, f).start()
+
+        for f in range(NF):
+            in_dma(slot, t, f).wait()
+        if substep:
+            for f in range(NF):
+                oin_dma(s3, t, f).wait()
+        else:
+            # no oin reload: compute itself reuses out_v[t%3], last drained
+            # as tile t-3's write-back source
+            @pl.when(t >= 3)
+            def _():
+                for f in range(NF):
+                    out_dma(s3, t - 3, f).wait()
+
+        # derivatives + physics over the tile, via the shared fd/equations
+        # implementation (reference: solve<step>, user_kernels.h:437-469)
+        fds = [field_data(_SlabView(in_v, (slot, f)), rect, ids) for f in range(NF)]
+        lnrho, uux, uuy, uuz, ax, ay, az, ss = fds
+        uu = (uux, uuy, uuz)
+        aa = (ax, ay, az)
+        rates = [None] * NF
+        rates[0] = continuity(uu, lnrho)
+        mom = momentum(c, uu, lnrho, ss, aa)
+        ind = induction(c, uu, aa)
+        rates[1], rates[2], rates[3] = mom
+        rates[4], rates[5], rates[6] = ind
+        rates[7] = entropy(c, ss, uu, lnrho, aa)
+
+        for f in range(NF):
+            curr_c = in_v[slot, f, H : H + tz, 8 : 8 + ty, :]
+            if substep:
+                old = out_v[s3, f, :, :, xs]
+                new = curr_c[:, :, xs] + beta * (
+                    alpha_over_pb * (curr_c[:, :, xs] - old) + rates[f] * dt
+                )
+            else:
+                new = curr_c[:, :, xs] + beta * dt * rates[f]
+            # non-compute columns carry curr so the store covers whole rows
+            out_v[s3, f] = curr_c
+            out_v[s3, f, :, :, xs] = new
+
+        for f in range(NF):
+            out_dma(s3, t, f).start()
+
+        # final drain: write-backs of tiles t-2, t-1, t are still pending
+        # (earlier ones were waited in the prefetch / pre-compute paths)
+        @pl.when(t == n_tiles - 1)
+        def _():
+            for f in range(NF):
+                if n_tiles >= 3:
+                    out_dma((t - 2) % 3, t - 2, f).wait()
+                if n_tiles >= 2:
+                    out_dma((t - 1) % 3, t - 1, f).wait()
+                out_dma(s3, t, f).wait()
+
+    shape = jax.ShapeDtypeStruct(
+        (pz, py, px), jnp.float32, vma=frozenset(vma) if vma is not None else None
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        out_shape=(shape,) * NF,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (2 * NF),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * NF,
+        scratch_shapes=[
+            pltpu.VMEM((2, NF, tz + 2 * H, rows_in, px), jnp.float32),
+            pltpu.VMEM((3, NF, tz, ty, px), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        input_output_aliases={NF + f: f for f in range(NF)},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            has_side_effects=True,
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    def apply(curr8, out8):
+        return fn(*curr8, *out8)
+
+    return apply

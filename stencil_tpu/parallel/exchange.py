@@ -154,14 +154,21 @@ class HaloExchange:
     def make_loop(self, iters: int):
         """``iters`` back-to-back exchanges in one compiled program — for
         benchmarking without per-dispatch host overhead (the analogue of the
-        reference's timed exchange loop, bin/exchange_weak.cu:168-177)."""
-        def many(state):
-            return lax.fori_loop(
-                0, iters, lambda _, s: jax.tree.map(self.exchange_block, s), state
-            )
+        reference's timed exchange loop, bin/exchange_weak.cu:168-177).
+        Loops are cached per ``iters``, so repeated calls reuse the jitted
+        program instead of retracing."""
+        cache = self.__dict__.setdefault("_loops", {})
+        if iters not in cache:
+            def many(state):
+                return lax.fori_loop(
+                    0, iters, lambda _, s: jax.tree.map(self.exchange_block, s), state
+                )
 
-        fn = jax.shard_map(many, mesh=self.mesh, in_specs=BLOCK_PSPEC, out_specs=BLOCK_PSPEC)
-        return jax.jit(fn, donate_argnums=0)
+            fn = jax.shard_map(
+                many, mesh=self.mesh, in_specs=BLOCK_PSPEC, out_specs=BLOCK_PSPEC
+            )
+            cache[iters] = jax.jit(fn, donate_argnums=0)
+        return cache[iters]
 
     def bytes_logical(self, itemsizes: Sequence[int]) -> int:
         """Total halo bytes delivered per exchange (reference-parity count)."""
