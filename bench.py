@@ -62,6 +62,20 @@ def main() -> int:
         st.insert((time.perf_counter() - t0) / chunk)
     ex_gb_s = ex.bytes_logical([4] * 4) / st.trimean() / 1e9
 
+    # astaroth flagship detail (BASELINE config 4 family): 256^3, 8 fp32
+    # fields, fused Pallas RK3 substeps; skipped off-accelerator or via
+    # STENCIL_BENCH_FAST=1 (compile adds ~90 s)
+    import os
+
+    asta_ms = None
+    if on_accel and not os.environ.get("STENCIL_BENCH_FAST"):
+        from stencil_tpu.apps.astaroth import run as asta_run
+
+        a = asta_run(
+            iters=10, devices=jax.devices()[:1], dtype="float32", nx=256, chunk=5
+        )
+        asta_ms = round(a["iter_trimean_s"] * 1e3, 2)
+
     value = round(mcells, 1)
     # the recorded baseline is a 512^3 TPU number; a CPU fallback run gets its
     # own metric name and no baseline ratio so the two are never conflated
@@ -85,6 +99,7 @@ def main() -> int:
                     "exchange_vs_baseline": (
                         round(ex_gb_s / BASELINE_EXCHANGE_GB_S, 3) if comparable else 0.0
                     ),
+                    "astaroth_256_iter_ms": asta_ms,
                     "platform": jax.devices()[0].platform,
                     "size": n,
                 },

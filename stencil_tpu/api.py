@@ -46,6 +46,7 @@ from .geometry import (
 from .parallel import HaloExchange, Method, grid_mesh
 from .parallel.exchange import direction_bytes, shard_blocks, unshard_blocks
 from .utils import logging as log
+from .utils import timer
 from .utils.sync import hard_sync
 
 
@@ -113,35 +114,42 @@ class DistributedDomain:
         """Partition, build the mesh, allocate quantities, compile exchange
         (reference: src/stencil.cu:241-850)."""
         t0 = time.perf_counter()
-        devices = list(self._devices) if self._devices is not None else jax.devices()
-        n = len(devices)
-        if self._partition_dim is not None:
-            dim = self._partition_dim
-        else:
-            # comm-minimizing two-level split: hosts x devices-per-host
-            # (reference: do_placement -> NodeAware, src/stencil.cu:201-239)
-            hosts = max(1, jax.process_count())
-            part = NodePartition(self.size, self.radius, hosts, max(1, n // hosts))
-            dim = part.dim()
-        if dim.flatten() != n:
-            raise ValueError(f"partition {dim} needs {dim.flatten()} devices, have {n}")
-        self.spec = GridSpec(self.size, dim, self.radius)
-        if self._placement is not None:
-            devices = self._placement.arrange(devices, self.spec)
-        self.mesh = grid_mesh(dim, devices, ordered=self._placement is not None)
+        with timer.timed("setup.plan"), timer.trace_range("stencil.plan"):
+            devices = (
+                list(self._devices) if self._devices is not None else jax.devices()
+            )
+            n = len(devices)
+            if self._partition_dim is not None:
+                dim = self._partition_dim
+            else:
+                # comm-minimizing two-level split: hosts x devices-per-host
+                # (reference: do_placement -> NodeAware, src/stencil.cu:201-239)
+                hosts = max(1, jax.process_count())
+                part = NodePartition(self.size, self.radius, hosts, max(1, n // hosts))
+                dim = part.dim()
+            if dim.flatten() != n:
+                raise ValueError(
+                    f"partition {dim} needs {dim.flatten()} devices, have {n}"
+                )
+            self.spec = GridSpec(self.size, dim, self.radius)
+            if self._placement is not None:
+                devices = self._placement.arrange(devices, self.spec)
+            self.mesh = grid_mesh(dim, devices, ordered=self._placement is not None)
         self.time_plan = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        shape = self.spec.stacked_shape_zyx()
-        self._exchange = HaloExchange(self.spec, self.mesh, self._method)
-        sharding = self._exchange.sharding()
-        for idx, dt in enumerate(self._dtypes):
-            self._curr[idx] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
-            self._next[idx] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
+        with timer.timed("setup.realize"), timer.trace_range("stencil.realize"):
+            shape = self.spec.stacked_shape_zyx()
+            self._exchange = HaloExchange(self.spec, self.mesh, self._method)
+            sharding = self._exchange.sharding()
+            for idx, dt in enumerate(self._dtypes):
+                self._curr[idx] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
+                self._next[idx] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
         self.time_realize = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        self._exchange._compiled  # build + trace now, like the two-phase prepare
+        with timer.timed("setup.create"), timer.trace_range("stencil.create"):
+            self._exchange._compiled  # build + trace now, like two-phase prepare
         self.time_create = time.perf_counter() - t0
         self._realized = True
         log.debug(
@@ -201,8 +209,9 @@ class DistributedDomain:
         a full host round-trip (~0.7 s on a tunneled TPU). For iteration
         loops use :meth:`exchange_loop` / :attr:`halo_exchange` instead."""
         t0 = time.perf_counter()
-        self._curr = self._exchange(self._curr)
-        hard_sync(self._curr)  # block_until_ready lies on the tunneled TPU
+        with timer.timed("exchange"), timer.trace_range("stencil.exchange"):
+            self._curr = self._exchange(self._curr)
+            hard_sync(self._curr)  # block_until_ready lies on the tunneled TPU
         self.time_exchange += time.perf_counter() - t0
         self.num_exchanges += 1
 
@@ -216,15 +225,17 @@ class DistributedDomain:
     def run_exchanges(self, iters: int) -> None:
         """Run ``iters`` fused exchanges on the domain's current state."""
         t0 = time.perf_counter()
-        self._curr = self.exchange_loop(iters)(self._curr)
-        hard_sync(self._curr)
+        with timer.timed("exchange"), timer.trace_range("stencil.exchange_loop"):
+            self._curr = self.exchange_loop(iters)(self._curr)
+            hard_sync(self._curr)
         self.time_exchange += time.perf_counter() - t0
         self.num_exchanges += iters
 
     def swap(self) -> None:
         """Swap curr/next (reference: src/stencil.cu:852-872)."""
         t0 = time.perf_counter()
-        self._curr, self._next = self._next, self._curr
+        with timer.timed("swap"):
+            self._curr, self._next = self._next, self._curr
         self.time_swap += time.perf_counter() - t0
 
     def get_interior(self) -> List[Rect3]:

@@ -33,6 +33,7 @@ from ..astaroth.reductions import Reductions
 from ..geometry import Dim3, prime_factors
 from ..parallel import Method
 from ..apps._bench_common import placement_from_flags
+from ..utils import timer
 from ..utils.statistics import Statistics
 from ..utils.sync import hard_sync
 from ..utils import logging as log
@@ -258,6 +259,7 @@ def main(argv: Optional[list] = None) -> int:
         chunk=args.chunk,
     )
     print(csv_row(r))
+    log.info(timer.report())
     if "reductions" in r:
         for k, v in r["reductions"].items():
             log.info(f"{k}: {v}")

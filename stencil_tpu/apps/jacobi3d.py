@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..api import DistributedDomain
 from ..geometry import Dim3, prime_factors
 from ..ops.jacobi import INIT_TEMP, make_jacobi_loop, make_jacobi_step, sphere_sel
+from ..utils import timer
 from ..parallel import Method
 from ..parallel.exchange import shard_blocks
 from ..utils.statistics import Statistics
@@ -197,6 +198,7 @@ def main(argv: Optional[list] = None) -> int:
     )
     print(csv_row(r))
     log.info(f"mcells/s = {r['mcells_per_s']:.1f} ({r['mcells_per_s_per_dev']:.1f}/device)")
+    log.info(timer.report())
     return 0
 
 

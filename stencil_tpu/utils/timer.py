@@ -44,6 +44,15 @@ def trace_range(name: str):
         yield
 
 
+def report() -> str:
+    """One-line bucket summary, the analogue of the reference's exit print
+    of timers::cudaRuntime/timers::mpi (reference: bin/jacobi3d.cu:397-398)."""
+    if not buckets:
+        return "timers: (empty)"
+    parts = [f"{k}={v:.3f}s" for k, v in sorted(buckets.items())]
+    return "timers: " + " ".join(parts)
+
+
 def time_fn(bucket: str):
     """Decorator: accumulate a function's wall time into a bucket."""
 

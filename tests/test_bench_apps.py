@@ -5,7 +5,15 @@ import jax
 import numpy as np
 import pytest
 
-from stencil_tpu.apps import bench_exchange, bench_pack, bench_qap, exchange_strong, exchange_weak, pingpong
+from stencil_tpu.apps import (
+    bench_exchange,
+    bench_pack,
+    bench_qap,
+    exchange_strong,
+    exchange_weak,
+    measure_overlap,
+    pingpong,
+)
 
 
 def test_exchange_weak_csv():
@@ -57,6 +65,21 @@ def test_bench_qap_rows():
     )
     for r in rows:
         assert np.isfinite(r["cost"]) and r["s"] >= 0
+
+
+def test_measure_overlap_row(tmp_path):
+    r = measure_overlap.run(
+        8, 8, 8, iters=2, rounds=2, devices=jax.devices()[:8],
+        trace_dir=str(tmp_path / "trace"),
+    )
+    row = measure_overlap.csv_row(r)
+    assert row.startswith("measure_overlap,8,")
+    for k in ("compute_s", "exchange_s", "serial_s", "overlap_s"):
+        assert r[k] > 0
+    # serial = exchange + full sweep, so it cannot beat the compute floor
+    assert r["serial_s"] > r["compute_s"] * 0.5
+    # the profiler trace artifact was written
+    assert any((tmp_path / "trace").rglob("*")), "no trace files written"
 
 
 def test_pingpong_rows():
