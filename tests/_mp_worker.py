@@ -1,0 +1,76 @@
+"""Worker for the 2-process x 4-virtual-CPU-device exchange test.
+
+Each process runs this SPMD-style: initialize the distributed runtime,
+build the same DistributedDomain over the 8 global devices, exchange, and
+verify the halos of the blocks THIS process hosts against the bit-packed
+coordinate pattern (the reference's multi-rank verification idiom,
+test_cuda_mpi_distributed_domain.cu:11-67).
+
+Usage: python _mp_worker.py <rank> <num_processes> <port>
+"""
+
+import sys
+
+sys.path.insert(0, sys.path[0] + "/..")  # repo root
+
+rank, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+from stencil_tpu.parallel.distributed import init_distributed, local_devices
+
+pid, pcount = init_distributed(
+    coordinator=f"localhost:{port}",
+    num_processes=nprocs,
+    process_id=rank,
+    local_cpu_devices=4,
+)
+assert (pid, pcount) == (rank, nprocs), (pid, pcount)
+
+import jax
+import numpy as np
+
+from stencil_tpu.api import DistributedDomain
+
+assert len(jax.devices()) == 4 * nprocs
+assert len(local_devices()) == 4
+
+dd = DistributedDomain(24, 20, 16)
+dd.set_radius(2)
+h = dd.add_data("q", np.float32)
+dd.realize()
+
+g = dd.size
+coords = (
+    np.arange(g.z)[:, None, None] * 1000000
+    + np.arange(g.y)[None, :, None] * 1000
+    + np.arange(g.x)[None, None, :]
+).astype(np.float32)
+dd.set_curr_global(h, coords)
+dd.exchange()
+
+# verify every halo cell of every LOCALLY-hosted block
+spec = dd.halo_exchange.spec
+arr = dd.get_curr(h)
+off = spec.compute_offset()
+r = spec.radius
+checked = bad = 0
+for shard in arr.addressable_shards:
+    # shard.index is the global (bz, by, bx, pz, py, px) slice tuple
+    iz = shard.index[0].start or 0
+    iy = shard.index[1].start or 0
+    ix = shard.index[2].start or 0
+    blk = np.asarray(shard.data)[0, 0, 0]
+    o = spec.block_origin((ix, iy, iz))
+    s = spec.block_size((ix, iy, iz))
+    for zz in range(-r.z(-1), s.z + r.z(1)):
+        for yy in range(-r.y(-1), s.y + r.y(1)):
+            for xx in range(-r.x(-1), s.x + r.x(1)):
+                if 0 <= zz < s.z and 0 <= yy < s.y and 0 <= xx < s.x:
+                    continue
+                gz, gy, gx = (o.z + zz) % g.z, (o.y + yy) % g.y, (o.x + xx) % g.x
+                want = gz * 1000000 + gy * 1000 + gx
+                got = blk[off.z + zz, off.y + yy, off.x + xx]
+                checked += 1
+                bad += got != want
+assert checked > 0 and bad == 0, (rank, checked, bad)
+print(f"MP_WORKER_OK rank={rank} blocks={len(arr.addressable_shards)} "
+      f"halo_cells={checked}", flush=True)
