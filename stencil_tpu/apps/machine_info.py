@@ -1,0 +1,76 @@
+"""machine-info — print the cluster/device inventory and link matrices.
+
+TPU-native analogue of the reference's machine-info executable
+(reference: bin/machine_info.cu:49-75, machine.hpp:106-140): dumps the
+Machine model (nodes, processes, devices with ICI coords) plus the
+distance and bandwidth matrices the NodeAware placement consumes — the
+introspection needed to trust placement on real hardware.
+
+Also prints the default partition the framework would choose for these
+devices (NodePartition hosts x devices-per-host), closing the loop from
+inventory to decomposition.
+
+Usage: python -m stencil_tpu.apps.machine_info [--cpu 8] [--size 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..geometry import Dim3, NodePartition, Radius
+from ..parallel.machine import Machine
+from ..utils import logging as log
+
+
+def run(devices=None, size: int = 256, radius: int = 1) -> dict:
+    m = Machine.detect(devices)
+    n = len(m.devices)
+    hosts = max(1, m.process_count)
+    part = NodePartition(
+        Dim3(size, size, size), Radius.constant(radius), hosts, max(1, n // hosts)
+    )
+    return {
+        "machine": m,
+        "dist": m.distance_matrix(),
+        "bw": m.bandwidth_matrix(),
+        "partition": part.dim(),
+        "size": size,
+    }
+
+
+def report(r: dict) -> str:
+    m: Machine = r["machine"]
+    with np.printoptions(precision=2, suppress=True, linewidth=200):
+        return "\n".join(
+            [
+                m.summary(),
+                f"default partition for {r['size']}^3: {r['partition']} "
+                "(hosts x devices/host min-interface split)",
+                "distance matrix (hops; self=0.1, remote=7.0):",
+                str(r["dist"]),
+                "bandwidth matrix (1/distance):",
+                str(r["bw"]),
+            ]
+        )
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="cluster/device inventory (TPU)")
+    p.add_argument("--size", type=int, default=256, help="domain for the partition hint")
+    p.add_argument("--radius", type=int, default=1)
+    p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
+    args = p.parse_args(argv)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+    r = run(size=args.size, radius=args.radius)
+    print(report(r))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

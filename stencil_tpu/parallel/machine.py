@@ -1,0 +1,130 @@
+"""Machine — the cluster model: hosts, processes, and their devices.
+
+TPU-native analogue of the reference's ``Machine`` (reference:
+machine.hpp:106-140, src/machine.cpp:72-147), which allgathers hostnames
+and GPU UUIDs over MPI to build a global inventory and deduplicate GPUs
+visible from multiple ranks. Under JAX the global device list is already
+unified — ``jax.devices()`` enumerates every chip of every process with
+its owning ``process_index``, so the UUID-dedup machinery is unnecessary;
+what remains is the host inventory (gathered with a byte-array allgather
+when multi-process, the MPI_Gather analogue of src/machine.cpp:85-101)
+and the per-device facts the placement layer consumes.
+
+Note the reference's ``Machine::gpu_distance`` was an unfinished stub
+(src/machine.cpp:132); here distances come fully implemented from
+``device_topo``.
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .device_topo import bandwidth_matrix, distance_matrix
+
+_HOSTNAME_BYTES = 64
+
+
+@dataclass(frozen=True)
+class DeviceInfo:
+    """One device's inventory row (reference: machine.cpp per-rank GPU
+    records)."""
+
+    index: int
+    platform: str
+    kind: str
+    process_index: int
+    coords: Optional[Tuple[int, ...]]
+    core_on_chip: Optional[int]
+
+
+@dataclass
+class Machine:
+    """Global inventory of processes, hosts, and devices."""
+
+    process_index: int
+    process_count: int
+    hostnames: Dict[int, str]  # process -> hostname
+    devices: List[DeviceInfo] = field(default_factory=list)
+    _raw_devices: List = field(default_factory=list, repr=False)
+
+    @classmethod
+    def detect(cls, devices: Optional[Sequence] = None) -> "Machine":
+        import jax
+
+        raw = list(devices) if devices is not None else jax.devices()
+        infos = [
+            DeviceInfo(
+                index=getattr(d, "id", i),
+                platform=d.platform,
+                kind=getattr(d, "device_kind", d.platform),
+                process_index=d.process_index,
+                coords=tuple(d.coords) if getattr(d, "coords", None) is not None else None,
+                core_on_chip=getattr(d, "core_on_chip", None),
+            )
+            for i, d in enumerate(raw)
+        ]
+        return cls(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            hostnames=_gather_hostnames(),
+            devices=infos,
+            _raw_devices=raw,
+        )
+
+    # -- queries (reference: machine.hpp:118-139) ---------------------------
+    def num_nodes(self) -> int:
+        return len(set(self.hostnames.values())) if self.hostnames else 1
+
+    def hostname_of_device(self, info: DeviceInfo) -> str:
+        return self.hostnames.get(info.process_index, "?")
+
+    def devices_of_process(self, process: int) -> List[DeviceInfo]:
+        return [d for d in self.devices if d.process_index == process]
+
+    def distance_matrix(self) -> np.ndarray:
+        return distance_matrix(self._raw_devices)
+
+    def bandwidth_matrix(self) -> np.ndarray:
+        return bandwidth_matrix(self._raw_devices)
+
+    def summary(self) -> str:
+        """Human-readable dump (the machine-info print,
+        reference: bin/machine_info.cu:49-75)."""
+        lines = [
+            f"machine: {self.num_nodes()} node(s), {self.process_count} "
+            f"process(es), {len(self.devices)} device(s)"
+        ]
+        for p in sorted({d.process_index for d in self.devices}):
+            lines.append(f"  process {p} on {self.hostnames.get(p, '?')}:")
+            for d in self.devices_of_process(p):
+                extra = ""
+                if d.coords is not None:
+                    extra += f" coords={d.coords}"
+                if d.core_on_chip is not None:
+                    extra += f" core={d.core_on_chip}"
+                lines.append(f"    device {d.index}: {d.platform} ({d.kind}){extra}")
+        return "\n".join(lines)
+
+
+def _gather_hostnames() -> Dict[int, str]:
+    """Hostname of every process (MPI_Gather analogue,
+    src/machine.cpp:85-101). Single-process: just this host."""
+    import jax
+
+    own = socket.gethostname()
+    if jax.process_count() == 1:
+        return {0: own}
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(_HOSTNAME_BYTES, dtype=np.uint8)
+    raw = own.encode()[:_HOSTNAME_BYTES]
+    buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf)  # (procs, BYTES)
+    return {
+        p: bytes(gathered[p]).rstrip(b"\x00").decode(errors="replace")
+        for p in range(gathered.shape[0])
+    }
