@@ -4,6 +4,12 @@ TPU-native port of the reference sweep (reference: bin/bench_exchange.cu):
 five radius shapes (+x-leaning, x-only, faces-only, face+edge, uniform) at a
 fixed per-run extent, reporting trimean seconds and aggregate B/s.
 
+``compare_methods`` additionally rows out AXIS_COMPOSED vs DIRECT26 on the
+uniform shape — the data-movement-strategy ablation that stands in for the
+reference's bench-mpi-pack pack-kernel-vs-derived-datatype comparison
+(reference: bin/bench_mpi_pack.cu:18-80): composed full-extent slabs (6
+collectives) against exact-extent per-direction messages (26 collectives).
+
 Usage: python -m stencil_tpu.apps.bench_exchange --x 256 --y 256 --z 256 --iters 30
 """
 
@@ -63,6 +69,28 @@ def run(x, y, z, iters=30, quantities=4, devices=None, method=Method.AXIS_COMPOS
     return rows
 
 
+def compare_methods(x, y, z, iters=30, quantities=4, devices=None, radius=2):
+    """AXIS_COMPOSED vs DIRECT26 at a uniform radius — the pack-strategy
+    ablation (see module docstring). Requires a partition that divides the
+    extents evenly (DIRECT26's uniform-blocks constraint)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    rows = []
+    for method in (Method.AXIS_COMPOSED, Method.DIRECT26):
+        r = time_exchange(
+            Dim3(x, y, z), Radius.constant(radius), iters, method=method,
+            devices=devices, quantities=quantities,
+        )
+        rows.append(
+            {
+                "config": f"{x}-{y}-{z}/method={method.value}",
+                "bytes": r["bytes_logical"],
+                "trimean_s": r["trimean_s"],
+                "bytes_per_s": r["bytes_logical"] / r["trimean_s"],
+            }
+        )
+    return rows
+
+
 def report_header() -> str:
     return "config,bytes,trimean (s),B/s"
 
@@ -72,11 +100,15 @@ def report_row(row: dict) -> str:
 
 
 def main(argv: Optional[list] = None) -> int:
+    from ..parallel.distributed import maybe_init_from_env
+    maybe_init_from_env()
     p = argparse.ArgumentParser(description="halo exchange radius-shape sweep")
     p.add_argument("--x", type=int, default=256)
     p.add_argument("--y", type=int, default=256)
     p.add_argument("--z", type=int, default=256)
     p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--methods", action="store_true",
+                   help="also compare AXIS_COMPOSED vs DIRECT26 (pack ablation)")
     p.add_argument("--cpu", type=int, default=0)
     args = p.parse_args(argv)
     if args.cpu:
@@ -85,6 +117,9 @@ def main(argv: Optional[list] = None) -> int:
     print(report_header())
     for row in run(args.x, args.y, args.z, iters=args.iters):
         print(report_row(row))
+    if args.methods:
+        for row in compare_methods(args.x, args.y, args.z, iters=args.iters):
+            print(report_row(row))
     return 0
 
 

@@ -24,6 +24,8 @@ def run(x, y, z, iters=30, **kw) -> dict:
 
 
 def main(argv: Optional[list] = None) -> int:
+    from ..parallel.distributed import maybe_init_from_env
+    maybe_init_from_env()
     p = argparse.ArgumentParser(description="strong-scaled halo exchange benchmark")
     p.add_argument("x", type=int)
     p.add_argument("y", type=int)

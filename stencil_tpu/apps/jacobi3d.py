@@ -164,6 +164,8 @@ def csv_row(r: dict) -> str:
 
 
 def main(argv: Optional[list] = None) -> int:
+    from ..parallel.distributed import maybe_init_from_env
+    maybe_init_from_env()
     p = argparse.ArgumentParser(description="3D Jacobi heat diffusion (TPU)")
     p.add_argument("--x", type=int, default=512)
     p.add_argument("--y", type=int, default=512)

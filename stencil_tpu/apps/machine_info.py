@@ -59,6 +59,8 @@ def report(r: dict) -> str:
 
 
 def main(argv: Optional[list] = None) -> int:
+    from ..parallel.distributed import maybe_init_from_env
+    maybe_init_from_env()
     p = argparse.ArgumentParser(description="cluster/device inventory (TPU)")
     p.add_argument("--size", type=int, default=256, help="domain for the partition hint")
     p.add_argument("--radius", type=int, default=1)

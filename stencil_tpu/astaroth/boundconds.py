@@ -1,0 +1,115 @@
+"""Symmetric / antisymmetric / periodic boundary conditions.
+
+TPU-native counterpart of the reference's boundary-condition kernels
+(reference: astaroth/boundconds.cuh). Semantics implemented as *intended*
+by the reference's index math (``src = 2*bound - dst``, mirroring about
+the first/last interior cell, sign +1 symmetric / -1 antisymmetric):
+
+    ghost[b0 - g] = sign * field[b0 + g]      (low side,  g = 1..r)
+    ghost[b1 + g] = sign * field[b1 - g]      (high side)
+
+Two reference caveats, preserved here as documentation rather than
+behavior: (a) the kernels are vestigial — ``astaroth.cu`` never calls
+them, the driver is periodic-only via the stencil library's exchange;
+(b) the reference's actual write line is
+``vtxbuf[dst] = sign*vtxbuf[src] * 0.0 + 1.0`` (boundconds.cuh:127),
+i.e. the mirror is multiplied away and the ghost is set to the constant
+1.0 — a disabled/debug state. We implement the real mirror, which is what
+any non-periodic Astaroth run needs.
+
+These operate on a padded [.., z, y, x] block along axes whose partition
+has a single block (a *domain* boundary is a *block* boundary only
+there); multi-block non-periodic axes would need masked exchange and are
+out of scope exactly as in the reference (Topology is periodic-only,
+src/topology.cpp:10-17).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from ..domain.grid import GridSpec
+
+SYMMETRIC = "symmetric"
+ANTISYMMETRIC = "antisymmetric"
+PERIODIC = "periodic"
+
+_AXIS_DIM = {"z": -3, "y": -2, "x": -1}
+
+
+def _axis_geom(spec: GridSpec, axis: str) -> Tuple[int, int, int, int]:
+    """(offset, size, r_minus, r_plus) along one axis."""
+    off = spec.compute_offset()
+    r = spec.radius
+    if axis == "x":
+        return off.x, spec.base.x, r.x(-1), r.x(1)
+    if axis == "y":
+        return off.y, spec.base.y, r.y(-1), r.y(1)
+    return off.z, spec.base.z, r.z(-1), r.z(1)
+
+
+def _take(arr, dim: int, idx: int):
+    sl = [slice(None)] * arr.ndim
+    sl[dim] = idx
+    return arr[tuple(sl)]
+
+
+def _put(arr, dim: int, idx: int, value):
+    sl = [slice(None)] * arr.ndim
+    sl[dim] = idx
+    return arr.at[tuple(sl)].set(value)
+
+
+def apply_mirror(arr, spec: GridSpec, axis: str, sign: int):
+    """Fill both ghost zones of ``axis`` by mirroring about the boundary
+    cells (reference: boundconds.cuh:44-111 index math).
+
+    ``arr`` is a padded block with leading dims allowed; the axis must
+    have a single block in the partition."""
+    if axis == "x":
+        n_blocks = spec.dim.x
+    elif axis == "y":
+        n_blocks = spec.dim.y
+    else:
+        n_blocks = spec.dim.z
+    if n_blocks != 1:
+        raise ValueError(
+            f"non-periodic {axis} boundary needs a single block on that axis"
+        )
+    o, sz, rm, rp = _axis_geom(spec, axis)
+    dim = arr.ndim + _AXIS_DIM[axis]
+    b0 = o  # first interior cell (boundloc0, boundconds.cuh:31)
+    b1 = o + sz - 1  # last interior cell (boundloc1)
+    for g in range(1, rm + 1):
+        arr = _put(arr, dim, b0 - g, sign * _take(arr, dim, b0 + g))
+    for g in range(1, rp + 1):
+        arr = _put(arr, dim, b1 + g, sign * _take(arr, dim, b1 - g))
+    return arr
+
+
+def symmetric(arr, spec: GridSpec, axis: str):
+    """sign=+1 (reference: acKernelSymmetricBoundconds)."""
+    return apply_mirror(arr, spec, axis, +1)
+
+
+def antisymmetric(arr, spec: GridSpec, axis: str):
+    """sign=-1 (reference: acKernelAntisymmetricBoundconds)."""
+    return apply_mirror(arr, spec, axis, -1)
+
+
+def apply_boundconds(arr, spec: GridSpec, kinds: Dict[str, str]):
+    """Apply per-axis boundary conditions to a padded block.
+
+    ``kinds`` maps axis name ('x'/'y'/'z') to SYMMETRIC/ANTISYMMETRIC/
+    PERIODIC; PERIODIC axes are left to the halo exchange (the driver's
+    only mode, astaroth.conf bcs)."""
+    for axis, kind in kinds.items():
+        if kind == PERIODIC:
+            continue
+        if kind == SYMMETRIC:
+            arr = symmetric(arr, spec, axis)
+        elif kind == ANTISYMMETRIC:
+            arr = antisymmetric(arr, spec, axis)
+        else:
+            raise ValueError(f"unknown boundary condition {kind!r}")
+    return arr

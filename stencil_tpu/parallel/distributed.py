@@ -64,6 +64,20 @@ def init_distributed(
     return jax.process_index(), jax.process_count()
 
 
+def maybe_init_from_env() -> bool:
+    """Initialize the distributed runtime iff the STENCIL_* launch env is
+    present (set by scripts/launch_multiprocess.sh or a cluster launcher);
+    no-op otherwise. Returns whether initialization happened. Apps call
+    this at the top of ``main()`` so the same CLI works single- and
+    multi-process."""
+    if not os.environ.get("STENCIL_COORDINATOR"):
+        return False
+    init_distributed(
+        local_cpu_devices=int(os.environ.get("STENCIL_LOCAL_CPU_DEVICES", "0"))
+    )
+    return True
+
+
 def colocated_devices(devices: Optional[Sequence] = None) -> Dict[int, List]:
     """Devices grouped by owning process — the ``MpiTopology.colocated``
     analogue (reference: mpi_topology.hpp:95)."""

@@ -52,6 +52,15 @@ def test_bench_exchange_sweep():
     assert rows[2]["bytes"] > rows[1]["bytes"]
 
 
+def test_bench_exchange_method_ablation():
+    rows = bench_exchange.compare_methods(16, 16, 16, iters=2, devices=jax.devices()[:8])
+    assert [r["config"].split("method=")[1] for r in rows] == [
+        "axis-composed", "direct26",
+    ]
+    # identical logical bytes — only the movement strategy differs
+    assert rows[0]["bytes"] == rows[1]["bytes"] > 0
+
+
 def test_bench_pack_rows():
     rows = bench_pack.run(16, 16, 16, radius=2, iters=3)
     assert len(rows) == 26

@@ -1,0 +1,75 @@
+"""Symmetric/antisymmetric boundary conditions vs a numpy mirror
+(reference: astaroth/boundconds.cuh — intended semantics; the reference
+kernel's write line is disabled, see boundconds.py docstring)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.astaroth.boundconds import (
+    ANTISYMMETRIC,
+    PERIODIC,
+    SYMMETRIC,
+    antisymmetric,
+    apply_boundconds,
+    symmetric,
+)
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+
+
+def _spec():
+    return GridSpec(Dim3(16, 16, 12), Dim3(1, 1, 1), Radius.constant(3))
+
+
+def _mirror_np(base, spec, axis, sign):
+    want = base.copy()
+    off = spec.compute_offset()
+    o = {"z": off.z, "y": off.y, "x": off.x}[axis]
+    sz = {"z": spec.base.z, "y": spec.base.y, "x": spec.base.x}[axis]
+    dim = {"z": 0, "y": 1, "x": 2}[axis]
+    b0, b1 = o, o + sz - 1
+    for g in range(1, 4):
+        sl_dst = [slice(None)] * 3
+        sl_src = [slice(None)] * 3
+        sl_dst[dim], sl_src[dim] = b0 - g, b0 + g
+        want[tuple(sl_dst)] = sign * base[tuple(sl_src)]
+        sl_dst[dim], sl_src[dim] = b1 + g, b1 - g
+        want[tuple(sl_dst)] = sign * base[tuple(sl_src)]
+    return want
+
+
+@pytest.mark.parametrize("axis", ["x", "y", "z"])
+@pytest.mark.parametrize("sign,fn", [(1, symmetric), (-1, antisymmetric)])
+def test_mirror_matches_numpy(axis, sign, fn):
+    spec = _spec()
+    p = spec.padded()
+    rng = np.random.RandomState(3)
+    base = rng.rand(p.z, p.y, p.x).astype(np.float32)
+    got = np.asarray(fn(jnp.asarray(base), spec, axis))
+    np.testing.assert_array_equal(got, _mirror_np(base, spec, axis, sign))
+
+
+def test_apply_boundconds_mixed():
+    spec = _spec()
+    p = spec.padded()
+    rng = np.random.RandomState(4)
+    base = rng.rand(p.z, p.y, p.x).astype(np.float32)
+    got = np.asarray(
+        apply_boundconds(
+            jnp.asarray(base), spec,
+            {"x": SYMMETRIC, "y": ANTISYMMETRIC, "z": PERIODIC},
+        )
+    )
+    want = _mirror_np(base, spec, "x", 1)
+    want = _mirror_np(want, spec, "y", -1)
+    np.testing.assert_array_equal(got, want)
+    # periodic z: untouched by boundconds (the exchange's job)
+    np.testing.assert_array_equal(got[:3], want[:3])
+
+
+def test_mirror_rejects_multiblock_axis():
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 1, 1), Radius.constant(3))
+    p = spec.padded()
+    with pytest.raises(ValueError):
+        symmetric(jnp.zeros((p.z, p.y, p.x)), spec, "x")
