@@ -84,7 +84,7 @@ def test_run_executes_exact_iteration_count():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("k", [1, 2, 3])
+@pytest.mark.parametrize("k", [1, 2, 3, 5])
 def test_pallas_multistep_matches_reference(k):
     """Temporal-blocked kernel (interpret mode): k fused steps must equal
     k applications of the numpy periodic reference, spheres included."""
