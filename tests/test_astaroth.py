@@ -237,19 +237,30 @@ def global_reference_iteration(fields, out, info, dt):
     return out, fields  # swap
 
 
-@pytest.mark.parametrize("overlap", [True, False])
-def test_distributed_step_matches_global_reference(overlap):
-    n = 16
+@pytest.mark.parametrize(
+    "overlap,size",
+    [
+        (True, (16, 16, 16)),
+        (False, (16, 16, 16)),
+        # uneven 2x2x2 split (blocks 10/9/7 per axis) — exercises the
+        # remainder-partition exchange under the full workload
+        (False, (20, 18, 14)),
+    ],
+)
+def test_distributed_step_matches_global_reference(overlap, size):
     info = ac_config.AcMeshInfo()
     with open(DEFAULT_CONF) as f:
         ac_config.parse_config(f.read(), info)
-    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.int_params["AC_nx"] = size[0]
+    info.int_params["AC_ny"] = size[1]
+    info.int_params["AC_nz"] = size[2]
     info.update_builtin_params()
     dt = 1e-3
 
-    size = Dim3(n, n, n)
+    size = Dim3(*size)
+    n = (size.z, size.y, size.x)
     rng = np.random.RandomState(0)
-    fields = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
+    fields = {k: rng.randn(*n) * 0.05 for k in FIELDS}
     fields["lnrho"] = fields["lnrho"] + 0.5
 
     spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(3))
@@ -258,11 +269,11 @@ def test_distributed_step_matches_global_reference(overlap):
     step = make_astaroth_step(ex, info, dt=dt, overlap=overlap)
 
     curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
-    nxt = {k: shard_blocks(np.zeros((n, n, n)), spec, mesh) for k in FIELDS}
+    nxt = {k: shard_blocks(np.zeros(n), spec, mesh) for k in FIELDS}
     curr, nxt = step(curr, nxt)
     got = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
 
-    ref_out = {k: np.zeros((n, n, n)) for k in FIELDS}
+    ref_out = {k: np.zeros(n) for k in FIELDS}
     ref_curr, _ = global_reference_iteration(dict(fields), ref_out, info, dt)
 
     for k in FIELDS:
