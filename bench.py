@@ -27,7 +27,8 @@ def main() -> int:
     # chunks amortize it (the reference's >=30-iteration timing loops,
     # bin/exchange_weak.cu:168-177, served the same purpose for CUDA
     # launch/MPI overhead)
-    chunk = 120 if on_accel else 3
+    # 360 amortizes the ~87 ms fixed dispatch cost to ~0.24 ms per iteration
+    chunk = 360 if on_accel else 3
 
     from stencil_tpu.apps.jacobi3d import run
     from stencil_tpu.utils.statistics import Statistics
@@ -71,8 +72,9 @@ def main() -> int:
     if on_accel and not os.environ.get("STENCIL_BENCH_FAST"):
         from stencil_tpu.apps.astaroth import run as asta_run
 
+        # chunk 30 amortizes the ~87 ms fixed dispatch cost to <3 ms/iter
         a = asta_run(
-            iters=10, devices=jax.devices()[:1], dtype="float32", nx=256, chunk=5
+            iters=60, devices=jax.devices()[:1], dtype="float32", nx=256, chunk=30
         )
         asta_ms = round(a["iter_trimean_s"] * 1e3, 2)
 
