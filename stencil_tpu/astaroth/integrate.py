@@ -180,7 +180,7 @@ def make_astaroth_step(
             return {k: v.reshape(like[k].shape) for k, v in zip(FIELDS, vals)}
 
         def exchange_all(curr):
-            return {k: ex.exchange_block(v) for k, v in curr.items()}
+            return ex.exchange_blocks(curr)
 
         def iteration(curr, out):
             if swap_per_substep:

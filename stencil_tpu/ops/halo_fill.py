@@ -55,15 +55,24 @@ def _axis_geom(spec: GridSpec, axis: str) -> Tuple[int, int, int]:
 _VMEM_BUDGET = 24 * 1024 * 1024
 
 
-def _x_tzb(spec: GridSpec) -> int:
-    """z-batch depth of the x kernel: deepest of 16/8/4 whose 8 buffers
-    fit the budget (v5e-measured at 256^3: TZB=16 4.25 ms vs TZB=4
-    6.01 ms — bigger DMAs amortize per-batch latency)."""
+def _x_tzb(spec: GridSpec, nq: int = 1) -> int:
+    """z-batch depth of the x kernel: deepest of 16/8/4/2 whose 8 buffers
+    (x nq quantities) fit the budget (v5e-measured at 256^3: TZB=16
+    4.25 ms vs TZB=4 6.01 ms — bigger DMAs amortize per-batch latency)."""
     p = spec.padded()
     tzb = 16
-    while tzb > 4 and (8 * tzb * p.y * _LANE * 4 > _VMEM_BUDGET or tzb > p.z):
+    while tzb > 2 and (8 * nq * tzb * p.y * _LANE * 4 > _VMEM_BUDGET or tzb > p.z):
         tzb //= 2
     return tzb
+
+
+def max_fill_group(spec: GridSpec) -> int:
+    """Largest quantity count a fused x fill can carry under the VMEM
+    budget (callers chunk larger quantity sets)."""
+    nq = 1
+    while nq < 16 and 8 * (nq + 1) * 2 * spec.padded().y * _LANE * 4 <= _VMEM_BUDGET:
+        nq += 1
+    return nq
 
 
 def _scratch_bytes(spec: GridSpec, axis: str) -> int:
@@ -78,7 +87,7 @@ def _scratch_bytes(spec: GridSpec, axis: str) -> int:
             t = (a // _SUB) * _SUB
             spans.append(-(-(b - t) // _SUB) * _SUB)
         return 2 * 8 * max(spans) * p.x * 4
-    return 8 * _x_tzb(spec) * p.y * _LANE * 4  # x: 4 double-buffered 2-slot buffers
+    return 8 * _x_tzb(spec) * p.y * _LANE * 4  # x (nq=1): 4 double-buffered 2-slot buffers
 
 
 def self_fill_supported(spec: GridSpec, axis: str, dtype) -> bool:
@@ -116,21 +125,35 @@ def self_fill_supported(spec: GridSpec, axis: str, dtype) -> bool:
     return True  # z: untiled dim, plane copies always work
 
 
-def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False):
-    """Build ``fill(block3d) -> block3d`` (aliased, in-place) filling the
-    periodic halo of one self-wrap axis of a (pz, py, px) fp32 block."""
+def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False,
+                   nq: int = 1):
+    """Build the in-place periodic fill for one self-wrap axis of fp32
+    (pz, py, px) blocks. ``nq == 1``: ``fill(block) -> block``; ``nq > 1``:
+    ``fill(b0, .., b{nq-1}) -> (b0', ..)`` — one kernel fills every
+    quantity's halo (the multi-quantity pack analogue, packer.cu:10-26),
+    amortizing per-kernel and per-batch overheads across quantities."""
     assert self_fill_supported(spec, axis, jnp.float32)
+    assert 1 <= nq <= max_fill_group(spec) or axis != "x", (nq, axis)
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     o, sz, (rm, rp) = _axis_geom(spec, axis)
-    if vma is None:
-        _out_shape = jax.ShapeDtypeStruct((pz, py, px), jnp.float32)
-    else:
-        _out_shape = jax.ShapeDtypeStruct((pz, py, px), jnp.float32, vma=frozenset(vma))
+    shape = jax.ShapeDtypeStruct(
+        (pz, py, px), jnp.float32, vma=frozenset(vma) if vma is not None else None
+    )
+    _out_shape = (shape,) * nq
+    _aliases = {q: q for q in range(nq)}
+
+    def _wrap(fn):
+        if nq == 1:
+            return lambda block: fn(block)[0]
+        return fn
 
     if axis == "z":
-        def kernel(blk, out, v, sem):
-            def copy(src, dst, n):
+        def kernel(*refs):
+            outs = refs[nq : 2 * nq]
+            v, sem = refs[2 * nq :]
+
+            def copy(out, src, dst, n):
                 cp = pltpu.make_async_copy(out.at[pl.ds(src, n)], v.at[pl.ds(0, n)], sem)
                 cp.start()
                 cp.wait()
@@ -138,30 +161,31 @@ def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False)
                 cp.start()
                 cp.wait()
 
-            if rm:
-                copy(o + sz - rm, o - rm, rm)  # top planes -> low halo
-            if rp:
-                copy(o, o + sz, rp)  # first planes -> high halo
+            for q in range(nq):
+                if rm:
+                    copy(outs[q], o + sz - rm, o - rm, rm)  # top planes -> low halo
+                if rp:
+                    copy(outs[q], o, o + sz, rp)  # first planes -> high halo
 
         nstage = max(rm, rp, 1)
-        return pl.pallas_call(
+        return _wrap(pl.pallas_call(
             kernel,
             grid=(1,),
             out_shape=_out_shape,
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nq,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nq,
             scratch_shapes=[
                 pltpu.VMEM((nstage, py, px), jnp.float32),
                 pltpu.SemaphoreType.DMA(()),
             ],
-            input_output_aliases={0: 0},
+            input_output_aliases=_aliases,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("arbitrary",),
                 has_side_effects=True,
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,
-        )
+        ))
 
     TZB = 8
     n_b = -(-pz // TZB)  # overlapping last batch: z is untiled, restart anywhere
@@ -180,64 +204,68 @@ def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False)
         spans = (lo_span, hi_span, src_lo_span, src_hi_span)
         vspan = max(spans)
 
-        def kernel(blk, out, dv, sv, sem):
+        def kernel(*refs):
+            outs = refs[nq : 2 * nq]
+            dv, sv, sem = refs[2 * nq :]
             i = pl.program_id(0)
             z0 = jnp.minimum(i * TZB, pz - TZB)
 
-            def rd(base, span, buf):
+            def rd(out, base, span, buf):
                 cp = pltpu.make_async_copy(
                     out.at[pl.ds(z0, TZB), pl.ds(base, span)], buf.at[:, pl.ds(0, span)], sem
                 )
                 cp.start()
                 cp.wait()
 
-            def wr(base, span, buf):
+            def wr(out, base, span, buf):
                 cp = pltpu.make_async_copy(
                     buf.at[:, pl.ds(0, span)], out.at[pl.ds(z0, TZB), pl.ds(base, span)], sem
                 )
                 cp.start()
                 cp.wait()
 
-            if rm:
-                rd(lo_t, lo_span, dv)
-                rd(src_hi_t, src_hi_span, sv)
-                # rows [o-rm, o) <- rows [o+sz-rm, o+sz)
-                dv[:, o - rm - lo_t : o - lo_t, :] = sv[
-                    :, o + sz - rm - src_hi_t : o + sz - src_hi_t, :
-                ]
-                wr(lo_t, lo_span, dv)
-            if rp:
-                rd(hi_t, hi_span, dv)
-                rd(src_lo_t, src_lo_span, sv)
-                # rows [o+sz, o+sz+rp) <- rows [o, o+rp)
-                dv[:, o + sz - hi_t : o + sz + rp - hi_t, :] = sv[
-                    :, o - src_lo_t : o + rp - src_lo_t, :
-                ]
-                wr(hi_t, hi_span, dv)
+            for q in range(nq):
+                out = outs[q]
+                if rm:
+                    rd(out, lo_t, lo_span, dv)
+                    rd(out, src_hi_t, src_hi_span, sv)
+                    # rows [o-rm, o) <- rows [o+sz-rm, o+sz)
+                    dv[:, o - rm - lo_t : o - lo_t, :] = sv[
+                        :, o + sz - rm - src_hi_t : o + sz - src_hi_t, :
+                    ]
+                    wr(out, lo_t, lo_span, dv)
+                if rp:
+                    rd(out, hi_t, hi_span, dv)
+                    rd(out, src_lo_t, src_lo_span, sv)
+                    # rows [o+sz, o+sz+rp) <- rows [o, o+rp)
+                    dv[:, o + sz - hi_t : o + sz + rp - hi_t, :] = sv[
+                        :, o - src_lo_t : o + rp - src_lo_t, :
+                    ]
+                    wr(out, hi_t, hi_span, dv)
 
-        return pl.pallas_call(
+        return _wrap(pl.pallas_call(
             kernel,
             grid=(n_b,),
             out_shape=_out_shape,
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nq,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nq,
             scratch_shapes=[
                 pltpu.VMEM((TZB, vspan, px), jnp.float32),
                 pltpu.VMEM((TZB, vspan, px), jnp.float32),
                 pltpu.SemaphoreType.DMA(()),
             ],
-            input_output_aliases={0: 0},
+            input_output_aliases=_aliases,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("arbitrary",),
                 has_side_effects=True,
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,
-        )
+        ))
 
     # axis == "x": rewrite both edge lane-tiles, double-buffered over z.
     # 8 buffers (rd/wr x lo/hi x 2 slots); depth picked by the VMEM budget
-    TZB = _x_tzb(spec)
+    TZB = _x_tzb(spec, nq)
     n_b = -(-pz // TZB)
     lo_t = 0
     hi_t = ((o + sz) // _LANE) * _LANE
@@ -248,7 +276,9 @@ def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False)
     tail_overlaps = (pz % TZB) != 0
     prefetch_limit = n_b - 1 if tail_overlaps else n_b
 
-    def kernel(blk, out, rd_lo, rd_hi, wr_lo, wr_hi, s_rlo, s_rhi, s_wlo, s_whi):
+    def kernel(*refs):
+        outs = refs[nq : 2 * nq]
+        rd_lo, rd_hi, wr_lo, wr_hi, s_rlo, s_rhi, s_wlo, s_whi = refs[2 * nq :]
         i = pl.program_id(0)
         slot = jnp.mod(i, 2)
         nslot = jnp.mod(i + 1, 2)
@@ -256,19 +286,34 @@ def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False)
         def z_of(step):
             return jnp.minimum(step * TZB, pz - TZB)
 
-        def rd(s, step, buf, sem, col):
+        def rd(s, q, step, buf, sem, col):
             return pltpu.make_async_copy(
-                out.at[pl.ds(z_of(step), TZB), :, pl.ds(col, _LANE)], buf.at[s], sem.at[s]
+                outs[q].at[pl.ds(z_of(step), TZB), :, pl.ds(col, _LANE)],
+                buf.at[s, q],
+                sem.at[s],
             )
 
-        def wr(s, step, buf, sem, col):
+        def wr(s, q, step, buf, sem, col):
             return pltpu.make_async_copy(
-                buf.at[s], out.at[pl.ds(z_of(step), TZB), :, pl.ds(col, _LANE)], sem.at[s]
+                buf.at[s, q],
+                outs[q].at[pl.ds(z_of(step), TZB), :, pl.ds(col, _LANE)],
+                sem.at[s],
             )
 
         def rd_both(s, step):
-            rd(s, step, rd_lo, s_rlo, lo_t).start()
-            rd(s, step, rd_hi, s_rhi, hi_t).start()
+            for q in range(nq):
+                rd(s, q, step, rd_lo, s_rlo, lo_t).start()
+                rd(s, q, step, rd_hi, s_rhi, hi_t).start()
+
+        def wr_start(s, step):
+            for q in range(nq):
+                wr(s, q, step, wr_lo, s_wlo, lo_t).start()
+                wr(s, q, step, wr_hi, s_whi, hi_t).start()
+
+        def wr_wait(s, step):
+            for q in range(nq):
+                wr(s, q, step, wr_lo, s_wlo, lo_t).wait()
+                wr(s, q, step, wr_hi, s_whi, hi_t).wait()
 
         @pl.when(i == 0)
         def _():
@@ -283,62 +328,59 @@ def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False)
             def _():
                 # non-prefetched tail batch: the overlapping previous write
                 # must land before reading
-                wr(nslot, i - 1, wr_lo, s_wlo, lo_t).wait()
-                wr(nslot, i - 1, wr_hi, s_whi, hi_t).wait()
+                wr_wait(nslot, i - 1)
                 rd_both(slot, i)
 
-        rd(slot, i, rd_lo, s_rlo, lo_t).wait()
-        rd(slot, i, rd_hi, s_rhi, hi_t).wait()
+        for q in range(nq):
+            rd(slot, q, i, rd_lo, s_rlo, lo_t).wait()
+            rd(slot, q, i, rd_hi, s_rhi, hi_t).wait()
 
         # the write buffers of batch i-2 (same slot) must have drained
         @pl.when(i >= 2)
         def _():
-            wr(slot, i - 2, wr_lo, s_wlo, lo_t).wait()
-            wr(slot, i - 2, wr_hi, s_whi, hi_t).wait()
+            wr_wait(slot, i - 2)
 
-        wr_lo[slot] = rd_lo[slot]
-        wr_hi[slot] = rd_hi[slot]
-        if rm:  # cols [o-rm, o) <- [o+sz-rm, o+sz) (hi tile)
-            wr_lo[slot, :, :, o - rm - lo_t : o - lo_t] = rd_hi[
-                slot, :, :, o + sz - rm - hi_t : o + sz - hi_t
-            ]
-        if rp:  # cols [o+sz, o+sz+rp) <- [o, o+rp) (lo tile)
-            wr_hi[slot, :, :, o + sz - hi_t : o + sz + rp - hi_t] = rd_lo[
-                slot, :, :, o - lo_t : o + rp - lo_t
-            ]
-        wr(slot, i, wr_lo, s_wlo, lo_t).start()
-        wr(slot, i, wr_hi, s_whi, hi_t).start()
+        for q in range(nq):
+            wr_lo[slot, q] = rd_lo[slot, q]
+            wr_hi[slot, q] = rd_hi[slot, q]
+            if rm:  # cols [o-rm, o) <- [o+sz-rm, o+sz) (hi tile)
+                wr_lo[slot, q, :, :, o - rm - lo_t : o - lo_t] = rd_hi[
+                    slot, q, :, :, o + sz - rm - hi_t : o + sz - hi_t
+                ]
+            if rp:  # cols [o+sz, o+sz+rp) <- [o, o+rp) (lo tile)
+                wr_hi[slot, q, :, :, o + sz - hi_t : o + sz + rp - hi_t] = rd_lo[
+                    slot, q, :, :, o - lo_t : o + rp - lo_t
+                ]
+        wr_start(slot, i)
 
         @pl.when(i == n_b - 1)
         def _():
             # wr(n_b-2): the overlap tail branch waited it; otherwise here
             if n_b >= 2 and not tail_overlaps:
-                wr(nslot, i - 1, wr_lo, s_wlo, lo_t).wait()
-                wr(nslot, i - 1, wr_hi, s_whi, hi_t).wait()
-            wr(slot, i, wr_lo, s_wlo, lo_t).wait()
-            wr(slot, i, wr_hi, s_whi, hi_t).wait()
+                wr_wait(nslot, i - 1)
+            wr_wait(slot, i)
 
-    return pl.pallas_call(
+    return _wrap(pl.pallas_call(
         kernel,
         grid=(n_b,),
         out_shape=_out_shape,
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nq,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nq,
         scratch_shapes=[
-            pltpu.VMEM((2, TZB, py, _LANE), jnp.float32),
-            pltpu.VMEM((2, TZB, py, _LANE), jnp.float32),
-            pltpu.VMEM((2, TZB, py, _LANE), jnp.float32),
-            pltpu.VMEM((2, TZB, py, _LANE), jnp.float32),
+            pltpu.VMEM((2, nq, TZB, py, _LANE), jnp.float32),
+            pltpu.VMEM((2, nq, TZB, py, _LANE), jnp.float32),
+            pltpu.VMEM((2, nq, TZB, py, _LANE), jnp.float32),
+            pltpu.VMEM((2, nq, TZB, py, _LANE), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        input_output_aliases={0: 0},
+        input_output_aliases=_aliases,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             has_side_effects=True,
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
-    )
+    ))

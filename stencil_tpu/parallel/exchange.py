@@ -138,10 +138,62 @@ class HaloExchange:
             return self._direct26_blocks(block)
         return self._composed_blocks(block, axes)
 
+    def exchange_blocks(self, state):
+        """Per-block exchange of a whole quantity dict inside ``shard_map``.
+
+        Unlike mapping :meth:`exchange_block` per quantity, fp32 quantities
+        on self-wrap axes share fused multi-quantity fill kernels (the
+        multi-quantity-pack analogue, packer.cu:10-26) — one kernel per
+        axis phase instead of one per quantity."""
+        if not isinstance(state, dict) or self.method == Method.DIRECT26:
+            return jax.tree.map(self.exchange_block, state)
+        fills = self._self_fills
+        if not fills:
+            return jax.tree.map(self.exchange_block, state)
+        from ..ops.halo_fill import max_fill_group
+
+        p = self.spec.padded()
+        gmax = max_fill_group(self.spec)
+        fused = [k for k in state if state[k].dtype == jnp.float32]
+        rest = [k for k in state if k not in fused]
+        out = dict(state)
+        for name, adim, _ in _AXES:
+            sizes, rm, rp, _off = _spec_axis(self.spec, name)
+            if rm == 0 and rp == 0:
+                continue
+            if len(sizes) == 1 and name in fills and fused:
+                for i in range(0, len(fused), gmax):
+                    chunk = fused[i : i + gmax]
+                    fill = self._multi_fill(name, len(chunk))
+                    res = fill(*[out[k].reshape(p.z, p.y, p.x) for k in chunk])
+                    res = (res,) if len(chunk) == 1 else res
+                    for k, v in zip(chunk, res):
+                        out[k] = v.reshape(state[k].shape)
+                for k in rest:
+                    out[k] = self._axis_phase(out[k], name, adim)
+            else:
+                for k in state:
+                    out[k] = self._axis_phase(out[k], name, adim)
+        return out
+
+    def _multi_fill(self, axis: str, nq: int):
+        cache = self.__dict__.setdefault("_multi_fills", {})
+        if (axis, nq) not in cache:
+            if nq == 1:
+                cache[(axis, nq)] = self._self_fills[axis]
+            else:
+                from ..ops.halo_fill import make_self_fill
+                from .mesh import MESH_AXES
+
+                cache[(axis, nq)] = make_self_fill(
+                    self.spec, axis, vma=MESH_AXES, nq=nq
+                )
+        return cache[(axis, nq)]
+
     @cached_property
     def _compiled(self):
         fn = jax.shard_map(
-            lambda state: jax.tree.map(self.exchange_block, state),
+            self.exchange_blocks,
             mesh=self.mesh,
             in_specs=BLOCK_PSPEC,
             out_specs=BLOCK_PSPEC,
@@ -161,7 +213,7 @@ class HaloExchange:
         if iters not in cache:
             def many(state):
                 return lax.fori_loop(
-                    0, iters, lambda _, s: jax.tree.map(self.exchange_block, s), state
+                    0, iters, lambda _, s: self.exchange_blocks(s), state
                 )
 
             fn = jax.shard_map(
