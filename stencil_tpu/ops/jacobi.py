@@ -77,7 +77,7 @@ def jacobi6_block(block, radius: Radius, masks=None):
 
 
 def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None,
-                     standard_spheres: bool = True):
+                     standard_spheres: bool = True, interpret: bool = False):
     """Build the jitted distributed iteration: exchange + stencil + swap.
 
     Returns ``step(curr, nxt, hot, cold) -> (new_curr, new_next)`` over
@@ -91,11 +91,11 @@ def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None,
     exchange-then-full-sweep (slab extents would be data-dependent).
     """
     return _compile_jacobi(ex, overlap, iters=None, use_pallas=use_pallas,
-                           standard_spheres=standard_spheres)
+                           standard_spheres=standard_spheres, interpret=interpret)
 
 
 def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pallas=None,
-                     standard_spheres: bool = True):
+                     standard_spheres: bool = True, interpret: bool = False):
     """Like :func:`make_jacobi_step` but runs ``iters`` iterations inside one
     compiled program (``lax.fori_loop``) — one host dispatch per chunk.
 
@@ -111,7 +111,7 @@ def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pal
     when driving the step with a custom or empty ``sel``.
     """
     return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas,
-                           standard_spheres=standard_spheres)
+                           standard_spheres=standard_spheres, interpret=interpret)
 
 
 def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
@@ -122,7 +122,7 @@ def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
 
 
 def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
-                    standard_spheres: bool = True):
+                    standard_spheres: bool = True, interpret: bool = False):
     spec = ex.spec
     r = spec.radius
     assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
@@ -155,8 +155,12 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
         else:
             wrap = (False, False, False)
             pallas_axes = None  # DIRECT26 has no axis phases to subset
+        # interpret mode (CI integration tests): the pallas HLO interpreter
+        # cannot propagate varying-manual-axes metadata
         pallas_sweep = make_pallas_jacobi_sweep(
-            spec, sel_z_range(spec), vma=MESH_AXES, wrap=wrap
+            spec, sel_z_range(spec),
+            vma=None if interpret else MESH_AXES,
+            wrap=wrap, interpret=interpret,
         )
 
     def body(curr, nxt, sel):
@@ -208,7 +212,10 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
         from .pallas_stencil import make_pallas_jacobi_multistep
         from ..parallel.mesh import MESH_AXES
 
-        multistep = make_pallas_jacobi_multistep(spec, TEMPORAL_K, vma=MESH_AXES)
+        multistep = make_pallas_jacobi_multistep(
+            spec, TEMPORAL_K,
+            vma=None if interpret else MESH_AXES, interpret=interpret,
+        )
 
     def entry_fn(curr, nxt, sel):
         if multistep is not None:
@@ -239,6 +246,7 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
         mesh=ex.mesh,
         in_specs=(BLOCK_PSPEC,) * 3,
         out_specs=(BLOCK_PSPEC, BLOCK_PSPEC),
+        check_vma=not interpret,
     )
     return jax.jit(fn, donate_argnums=(0, 1))
 
