@@ -26,26 +26,16 @@ src/topology.cpp:10-17).
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict
 
 from ..domain.grid import GridSpec
+from ..ops.halo_fill import _axis_geom
 
 SYMMETRIC = "symmetric"
 ANTISYMMETRIC = "antisymmetric"
 PERIODIC = "periodic"
 
 _AXIS_DIM = {"z": -3, "y": -2, "x": -1}
-
-
-def _axis_geom(spec: GridSpec, axis: str) -> Tuple[int, int, int, int]:
-    """(offset, size, r_minus, r_plus) along one axis."""
-    off = spec.compute_offset()
-    r = spec.radius
-    if axis == "x":
-        return off.x, spec.base.x, r.x(-1), r.x(1)
-    if axis == "y":
-        return off.y, spec.base.y, r.y(-1), r.y(1)
-    return off.z, spec.base.z, r.z(-1), r.z(1)
 
 
 def _take(arr, dim: int, idx: int):
@@ -76,7 +66,7 @@ def apply_mirror(arr, spec: GridSpec, axis: str, sign: int):
         raise ValueError(
             f"non-periodic {axis} boundary needs a single block on that axis"
         )
-    o, sz, rm, rp = _axis_geom(spec, axis)
+    o, sz, (rm, rp) = _axis_geom(spec, axis)
     dim = arr.ndim + _AXIS_DIM[axis]
     b0 = o  # first interior cell (boundloc0, boundconds.cuh:31)
     b1 = o + sz - 1  # last interior cell (boundloc1)

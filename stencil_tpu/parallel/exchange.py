@@ -162,8 +162,11 @@ class HaloExchange:
             if rm == 0 and rp == 0:
                 continue
             if len(sizes) == 1 and name in fills and fused:
-                for i in range(0, len(fused), gmax):
-                    chunk = fused[i : i + gmax]
+                # only the x kernel's scratch scales with the quantity
+                # count; y/z fills carry every quantity in one kernel
+                ax_gmax = gmax if name == AXIS_X else len(fused)
+                for i in range(0, len(fused), ax_gmax):
+                    chunk = fused[i : i + ax_gmax]
                     fill = self._multi_fill(name, len(chunk))
                     res = fill(*[out[k].reshape(p.z, p.y, p.x) for k in chunk])
                     res = (res,) if len(chunk) == 1 else res

@@ -52,6 +52,59 @@ def test_multi_quantity_fill_matches_per_quantity(axis):
         np.testing.assert_array_equal(np.asarray(got[q]), want)
 
 
+def test_exchange_blocks_fused_dispatch(monkeypatch):
+    """The fused/rest split, chunking, and reshape wiring of
+    HaloExchange.exchange_blocks — forced onto the fused path off-TPU by
+    injecting interpret-mode fill kernels, with max_fill_group shrunk to
+    exercise chunk boundaries (including a trailing nq=1 chunk)."""
+    import jax
+
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    import stencil_tpu.ops.halo_fill as HF
+    from stencil_tpu.parallel.exchange import shard_blocks
+
+    g = Dim3(140, 16, 16)
+    spec = GridSpec(g, Dim3(1, 1, 1), Radius.constant(2))
+    mesh = grid_mesh(spec.dim, jax.devices()[:1])
+    ex = HaloExchange(spec, mesh)
+    # inject interpret-mode fills (the TPU gate would otherwise leave
+    # _self_fills empty on CPU and the dispatch under test never runs)
+    ex.__dict__["_self_fills"] = {
+        a: HF.make_self_fill(spec, a, interpret=True) for a in ("x", "y", "z")
+    }
+    ex.__dict__["_multi_fills"] = {
+        (a, n): HF.make_self_fill(spec, a, interpret=True, nq=n)
+        for a in ("x", "y", "z")
+        for n in (1, 2, 3, 5)
+    }
+    monkeypatch.setattr(HF, "max_fill_group", lambda _spec: 2)
+
+    rng = np.random.RandomState(9)
+    coords = (
+        np.arange(g.z)[:, None, None] * 10000
+        + np.arange(g.y)[None, :, None] * 100
+        + np.arange(g.x)[None, None, :]
+    )
+    state = {i: shard_blocks(coords.astype(np.float32), spec, mesh) for i in range(5)}
+    state["f64"] = shard_blocks(coords.astype(np.float64), spec, mesh)
+    out = ex.exchange_blocks(state)
+
+    off = spec.compute_offset()
+    r = spec.radius
+    for key, arr in out.items():
+        blk = np.asarray(jax.device_get(arr))[0, 0, 0]
+        bad = checked = 0
+        for zz in range(-r.z(-1), g.z + r.z(1)):
+            for yy in range(-r.y(-1), g.y + r.y(1)):
+                for xx in range(-r.x(-1), g.x + r.x(1)):
+                    if 0 <= zz < g.z and 0 <= yy < g.y and 0 <= xx < g.x:
+                        continue
+                    want = (zz % g.z) * 10000 + (yy % g.y) * 100 + (xx % g.x)
+                    checked += 1
+                    bad += blk[off.z + zz, off.y + yy, off.x + xx] != want
+        assert checked > 0 and bad == 0, (key, bad)
+
+
 def test_max_fill_group_positive():
     from stencil_tpu.ops.halo_fill import max_fill_group
 
