@@ -232,6 +232,10 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--paraview-init", action="store_true")
     p.add_argument("--paraview-final", action="store_true")
     p.add_argument("--f32", action="store_true", help="float32 fields (TPU-native)")
+    p.add_argument("--f64", action="store_true",
+                   help="force float64 fields even on TPU (software-emulated "
+                        "and extremely slow there; the reference's native "
+                        "dtype on GPUs)")
     p.add_argument("--reductions", action="store_true", help="print field reductions")
     p.add_argument("--no-pallas", action="store_true",
                    help="force the unfused XLA substep path")
@@ -243,8 +247,16 @@ def main(argv: Optional[list] = None) -> int:
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
-    if not args.f32:
+    # dtype default: the reference's double on CPU, float32 on TPU (f64 is
+    # software-emulated on TPU — a 32^3 smoke test did not finish compiling
+    # in 25 minutes); --f64 forces the reference dtype anyway
+    use_f64 = args.f64 or (
+        not args.f32 and jax.devices()[0].platform != "tpu"
+    )
+    if use_f64:
         jax.config.update("jax_enable_x64", True)
+    elif not args.f32 and not args.f64:
+        log.info("TPU platform: defaulting to float32 fields (use --f64 to force)")
     r = run(
         iters=args.iters,
         conf=args.conf,
@@ -252,7 +264,7 @@ def main(argv: Optional[list] = None) -> int:
         random_=args.random,
         no_compute=args.no_compute,
         overlap=not args.no_overlap,
-        dtype="float32" if args.f32 else "float64",
+        dtype="float64" if use_f64 else "float32",
         nx=args.nx,
         paraview_init=args.paraview_init,
         paraview_final=args.paraview_final,
