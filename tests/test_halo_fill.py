@@ -38,6 +38,37 @@ def test_self_fill_matches_numpy(size, r, axis):
 
 
 @pytest.mark.parametrize("axis", ["x", "y", "z"])
+def test_self_fill_asymmetric_radius(axis):
+    # rm != rp per side (the reference's per-direction Radius semantics)
+    r = Radius.constant(0)
+    lo = {"x": (-1, 0, 0), "y": (0, -1, 0), "z": (0, 0, -1)}[axis]
+    hi = {"x": (1, 0, 0), "y": (0, 1, 0), "z": (0, 0, 1)}[axis]
+    r.set_dir(lo, 1)
+    r.set_dir(hi, 3)
+    spec = GridSpec(Dim3(140, 160, 40), Dim3(1, 1, 1), r)
+    assert self_fill_supported(spec, axis, jnp.float32)
+    p = spec.padded()
+    o = spec.compute_offset()
+    rng = np.random.RandomState(3)
+    base = rng.rand(p.z, p.y, p.x).astype(np.float32)
+    got = np.asarray(make_self_fill(spec, axis, interpret=True)(jnp.asarray(base)))
+    want = base.copy()
+    # active send dir d fills the receiver's -d halo: radius.dir(-d) gates,
+    # so lo-side halo width = r.dir(lo) = 1, hi-side = r.dir(hi) = 3
+    sx, sy, sz = 140, 160, 40
+    if axis == "z":
+        want[o.z - 1 : o.z] = base[o.z + sz - 1 : o.z + sz]
+        want[o.z + sz : o.z + sz + 3] = base[o.z : o.z + 3]
+    elif axis == "y":
+        want[:, o.y - 1 : o.y, :] = base[:, o.y + sy - 1 : o.y + sy, :]
+        want[:, o.y + sy : o.y + sy + 3, :] = base[:, o.y : o.y + 3, :]
+    else:
+        want[:, :, o.x - 1 : o.x] = base[:, :, o.x + sx - 1 : o.x + sx]
+        want[:, :, o.x + sx : o.x + sx + 3] = base[:, :, o.x : o.x + 3]
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("axis", ["x", "y", "z"])
 def test_multi_quantity_fill_matches_per_quantity(axis):
     # fused nq=3 kernel must equal three independent single-quantity fills
     spec = GridSpec(Dim3(140, 160, 40), Dim3(1, 1, 1), Radius.constant(2))
