@@ -73,6 +73,14 @@ def run(
     use_pallas=None,
     chunk: int = 1,
 ) -> dict:
+    """Run ``iters`` iterations (plus one untimed warmup chunk) and return
+    timing stats + the domain.
+
+    Iterations execute in fused chunks of ``chunk`` compiled together; when
+    ``chunk`` does not divide ``iters``, the count is rounded UP to the next
+    chunk multiple (a tail program would double the compile cost for a
+    benchmark driver) — the returned ``iters_run`` records the actual
+    number of timed iterations the state advanced."""
     devices = list(devices) if devices is not None else jax.devices()
     info, ok = load_config(conf)
     if not ok:
@@ -194,6 +202,7 @@ def run(
         "global": size,
         "iter_trimean_s": iter_time.trimean(),
         "exch_trimean_s": exch_time.trimean(),
+        "iters_run": iter_time.count(),
         "domain": dd,
         "handles": handles,
         "info": info,

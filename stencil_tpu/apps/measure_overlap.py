@@ -25,10 +25,12 @@ README.md:91-130).
 CSV: devices,x,y,z,radius,iters,compute_s,exchange_s,serial_s,overlap_s,
 hidden_s,hidden_frac
 
-Note: the Pallas fast path currently runs exchange-then-sweep (self-wrap
-axes are handled inside the kernel, multi-block axes serialize), so this
-app measures the XLA path by default; pass --pallas to quantify exactly
-what the Pallas path's serialization costs on a multi-block mesh.
+Note: with --pallas the serial/overlap variants run the fused-kernel fast
+path (the overlap variant is the full-sweep-on-pre-exchange-data + shell
+patch structure of ops/jacobi.py; its dataflow independence is machine-
+checked by tests/test_overlap_hlo.py). Pallas kernels execute on TPU
+only, so --pallas requires real chips — the default XLA path is what the
+virtual CPU mesh can run.
 
 Usage: python -m stencil_tpu.apps.measure_overlap --cpu 8 --x 64
 """

@@ -144,9 +144,13 @@ def make_astaroth_step(
     substep kernel (ops/pallas_astaroth.py). The Pallas path exchanges
     once per iteration — legitimate because the in buffers do not change
     between substeps in reference swap-per-iteration mode, and
-    re-exchanged before every substep in swap_per_substep mode — and runs
-    exchange-then-compute (no interior/exterior split; the fused kernel's
-    whole-region pass is faster than the split was)."""
+    re-exchanged before every substep in swap_per_substep mode. With
+    ``overlap`` on a multi-block mesh, that one exchange is scheduled
+    concurrently with substep 0's full-region kernel pass (which reads
+    pre-exchange data); the multi-block-axis shells of substep 0 are then
+    re-integrated from the exchanged halos — the reference's
+    interior/exterior overlap re-expressed as dataflow with the fused
+    kernel as the interior."""
     spec = ex.spec
     r = spec.radius
     assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
@@ -190,15 +194,42 @@ def make_astaroth_step(
         def exchange_all(curr):
             return ex.exchange_blocks(curr)
 
+        # overlapped fast path: substep 0's kernel pass reads PRE-exchange
+        # halos on EVERY axis (this kernel has no in-kernel wrap — the
+        # wrap-in-kernel experiment was measured and removed, BASELINE.md),
+        # so every side's shell must be re-integrated from the exchanged
+        # state, self-wrap axes included: exactly the XLA path's
+        # ``exteriors`` rects.
+        multi_block = spec.dim.flatten() > 1
+
         def iteration(curr, out):
             if swap_per_substep:
+                # textbook mode: every substep consumes a fresh exchange, so
+                # nothing can be computed ahead of it (and substeps 1/2
+                # would need the pre-update out at shell cells, which the
+                # in-place kernel destroys) — exchange-then-compute
                 for s in range(3):
                     curr = exchange_all(curr)
                     out = untuple(kernels[s](to3(curr), to3(out)), out)
                     curr, out = out, curr
                 return curr, out
-            curr = exchange_all(curr)
-            for s in range(3):
+            # reference swap-per-iteration mode: the in buffers are constant
+            # across substeps, so the iteration's single exchange can fly
+            # while substep 0 computes the full region from PRE-exchange
+            # data (reference: interior integrate concurrent with
+            # dd.exchange(), astaroth.cu:551-641). Substep 0's RK3 stage
+            # never reads the out buffer, so re-integrating the
+            # multi-block-axis shells from the exchanged halos afterwards
+            # is exact; substeps 1 and 2 read post-exchange data directly.
+            if use_overlap and multi_block:
+                out = untuple(kernels[0](to3(curr), to3(out)), out)
+                curr = exchange_all(curr)
+                for rect in exteriors:
+                    out = _integrate_region(0, rect, inv_ds, c, dt, curr, out)
+            else:
+                curr = exchange_all(curr)
+                out = untuple(kernels[0](to3(curr), to3(out)), out)
+            for s in (1, 2):
                 out = untuple(kernels[s](to3(curr), to3(out)), out)
             return out, curr  # one swap per iteration (astaroth.cu:642-648)
 

@@ -163,24 +163,61 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
             wrap=wrap, interpret=interpret,
         )
 
+    # shells to re-sweep from exchanged halos when the Pallas fast path
+    # overlaps comm with compute: only the sides whose axis actually has
+    # multiple blocks (self-wrap sides are filled inside the kernel). The
+    # redundant compute is the shell volume (~6 r-thick faces, <1% at
+    # benchmark sizes) — the price of making the full-region kernel the
+    # "interior" of the reference's overlap structure
+    # (bin/jacobi3d.cu:296-368) without a second kernel variant.
+    pallas_shells = []
+    if pallas_sweep is not None and pallas_axes:
+        shrink_lo = Dim3(
+            r.x(-1) if spec.dim.x > 1 else 0,
+            r.y(-1) if spec.dim.y > 1 else 0,
+            r.z(-1) if spec.dim.z > 1 else 0,
+        )
+        shrink_hi = Dim3(
+            r.x(1) if spec.dim.x > 1 else 0,
+            r.y(1) if spec.dim.y > 1 else 0,
+            r.z(1) if spec.dim.z > 1 else 0,
+        )
+        inner = Rect3(compute.lo + shrink_lo, compute.hi - shrink_hi)
+        pallas_shells = exterior_regions(compute, inner)
+
     def body(curr, nxt, sel):
         if pallas_sweep is not None:
-            # the Pallas sweep consumes exchanged halos on multi-block axes,
-            # so the structure is exchange-then-sweep; self-wrap axes are
-            # handled inside the kernel
+            p = spec.padded()
+
+            def sweep3(c, n):
+                return pallas_sweep(
+                    c.reshape(p.z, p.y, p.x),
+                    n.reshape(p.z, p.y, p.x),
+                    sel.reshape(p.z, p.y, p.x),
+                ).reshape(nxt.shape)
+
             if pallas_axes is None:  # DIRECT26: no axis phases to subset
                 cur2 = ex.exchange_block(curr)
-            elif pallas_axes:
-                cur2 = ex.exchange_block(curr, axes=pallas_axes)
-            else:  # every axis self-wraps: no exchange at all
-                cur2 = curr
-            p = spec.padded()
-            out = pallas_sweep(
-                cur2.reshape(p.z, p.y, p.x),
-                nxt.reshape(p.z, p.y, p.x),
-                sel.reshape(p.z, p.y, p.x),
-            ).reshape(nxt.shape)
-            return out, cur2
+                return sweep3(cur2, nxt), cur2
+            if not pallas_axes:  # every axis self-wraps: no exchange at all
+                return sweep3(curr, nxt), curr
+            if use_overlap:
+                # overlap as dataflow (reference: interior kernel concurrent
+                # with the exchange, src/stencil.cu:1002-1186): the full
+                # sweep reads PRE-exchange data — XLA is free to schedule
+                # the ppermutes concurrently — then the multi-block-axis
+                # shells are re-swept from the exchanged halos. The shells'
+                # stencils also read self-wrap-axis halos, which the kernel
+                # normally wraps internally, so this path runs the FULL
+                # exchange (self-wrap fills included), not the subset
+                out = sweep3(curr, nxt)
+                cur2 = ex.exchange_block(curr)
+                masks = (sel == 1, sel == 2)
+                for rect in pallas_shells:
+                    out = jacobi_sweep(cur2, out, rect, masks)
+                return out, cur2
+            cur2 = ex.exchange_block(curr, axes=pallas_axes)
+            return sweep3(cur2, nxt), cur2
         masks = (sel == 1, sel == 2)
         if use_overlap:
             out = jacobi_sweep(curr, nxt, interior, masks)

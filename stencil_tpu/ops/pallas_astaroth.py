@@ -3,16 +3,24 @@
 XLA's codegen for the unfused substep materializes the shifted-slice
 operands of 60+ derivative pencils in HBM (measured ~266 ms per 256^3 fp32
 substep triple on v5e, vs a ~5 GB/substep traffic roofline of ~6 ms). This
-kernel streams (tz, ty)-row slabs of all 8 fields HBM->VMEM with
-double-buffered DMA (the pipeline structure of ops/pallas_stencil.py),
-evaluates every derivative and the four MHD right-hand sides entirely in
-VMEM, applies the Williamson RK3 stage update, and streams finished tiles
-back.
+kernel walks each (ty)-row strip of the block in z with a **sliding window
+of field planes held in VMEM**: per z-tile only the ``tz`` fresh planes are
+fetched from HBM (prefetched into a parity-double-buffered stage while the
+previous tile computes), the window shifts down in VMEM, and every
+derivative and the four MHD right-hand sides are evaluated entirely in
+VMEM before the Williamson RK3 stage update streams finished tiles back.
+
+The round-2 version re-fetched the full (tz + 6)-plane halo slab per tile,
+a (tz+6)/tz = 4x z-read amplification at the VMEM-forced tz=2 (measured
+18.3 ms/substep at 256^3 against a ~7 ms traffic roofline). The sliding
+window reads each input plane once per strip, so z-amplification falls to
+(nz+6)/nz and the remaining input amplification is the 8-row-aligned y
+window ((ty+16)/ty) times the x lane padding (px/nx).
 
 The math is NOT duplicated: derivative pencils come from
 ``astaroth.fd.field_data`` and the physics from ``astaroth.equations`` —
 the same functions the XLA path executes — applied to VMEM refs through a
-slab-local view adapter. Parity between the two paths is therefore
+window-local view adapter. Parity between the two paths is therefore
 structural (pinned by tests/test_pallas_astaroth.py in interpret mode).
 
 Layout contract: padded fp32 blocks with TPU-aligned planes
@@ -22,12 +30,19 @@ composition provides them). The kernel writes compute rows only: out's
 x-halo columns in written rows carry the curr value (refreshed by the next
 exchange before any read), y/z halo rows/planes keep their prior contents.
 
-Buffering: ``in_v`` is double-buffered (tile t+1's field slabs load during
-tile t's compute). ``out_v`` is TRIPLE-buffered because three parties touch
-a slot: the out-read DMA of tile t (prefetched at t-1, substep > 0), the
-compute of tile t, and the write-back of tile t which drains while tiles
-t+1/t+2 proceed; slot t%3 is safe to reload once the write-back of tile
-t-3 has drained (waited in the prefetch path).
+Buffering discipline (the documented lag-1 rule: a DMA started at grid
+step t may write a buffer last touched by compute at step t-1, never one
+step t itself reads):
+
+- ``win`` (single buffer, per strip): the strip-start DMA filling it is
+  issued at the strip's first tile, one step after the previous strip's
+  last compute read it.
+- ``stage`` (2 slots by z-tile parity): tile zi's compute consumes slot
+  zi%2 while the DMA for tile zi+1 fills slot (zi+1)%2.
+- ``out_v`` (3 slots): the out-read DMA of tile t (prefetched at t-1,
+  substep > 0), the compute of tile t, and the write-back of tile t which
+  drains while tiles t+1/t+2 proceed; slot t%3 is safe to reload once the
+  write-back of tile t-3 has drained.
 
 Reference parity: the fused integrate of astaroth/kernels.cu:62-87
 (``solve<step>`` over the full subdomain) with the block-size autotuning of
@@ -57,7 +72,7 @@ RK3_BETA = (1.0 / 3.0, 15.0 / 16.0, 8.0 / 15.0)
 
 # VMEM budget for the explicit scratch buffers (v5e-measured: ~34 MB of
 # scratch still compiles, ~45 MB does not once Mosaic's expression
-# temporaries for the tile DAG are added; 22 MB leaves solid headroom).
+# temporaries for the tile DAG are added; see scripts/probe_r03.py).
 _SCRATCH_BUDGET = 22 * 1024 * 1024
 _HALO = 3  # 6th-order stencils, fixed (reference: astaroth.h STENCIL_ORDER 6)
 
@@ -66,24 +81,29 @@ def _divisors(n: int, cands) -> list:
     return [c for c in cands if c <= n and n % c == 0]
 
 
+def scratch_bytes(spec: GridSpec, tz: int, ty: int) -> int:
+    """Explicit VMEM scratch of the sliding-window substep at (tz, ty)."""
+    px = spec.padded().x
+    rows_in = ty + 16
+    win = NF * (tz + 2 * _HALO) * rows_in * px
+    stage = 2 * NF * tz * rows_in * px
+    out = 3 * NF * tz * ty * px
+    return 4 * (win + stage + out)
+
+
 def pick_tiles(spec: GridSpec) -> Tuple[int, int]:
     """(tz, ty) under the scratch budget (the autotuner analogue,
-    integration.cuh:130-215). Wide-y tiles measured fastest on v5e (the
-    derivative pencils' sublane rotates amortize over more rows):
-    256^3 sweep gave (2,64) 18.3 ms vs (4,8) 25.6 ms per substep — so the
-    key prefers the largest ty, then the smallest slab read
-    amplification."""
-    p = spec.padded()
+    integration.cuh:130-215). Input amplification is (ty+16)/ty — z reads
+    are amortized by the sliding window — so the key prefers the largest
+    ty, then the largest tz (fewer tiles: fewer DMA descriptors and less
+    window-shift work per output plane)."""
     nz, ny = spec.base.z, spec.base.y
     best = None
     for tz in _divisors(nz, (16, 12, 8, 6, 4, 3, 2, 1)):
-        for ty in _divisors(ny, (64, 48, 32, 24, 16, 8)):
-            in_bytes = 2 * NF * (tz + 2 * _HALO) * (ty + 16) * p.x * 4
-            out_bytes = 3 * NF * tz * ty * p.x * 4
-            if in_bytes + out_bytes > _SCRATCH_BUDGET:
+        for ty in _divisors(ny, (128, 96, 64, 48, 32, 24, 16, 8)):
+            if scratch_bytes(spec, tz, ty) > _SCRATCH_BUDGET:
                 continue
-            amp = ((tz + 2 * _HALO) * (ty + 16)) / (tz * ty)
-            key = (-min(ty, 64), amp, -(tz * ty))
+            key = (-ty, -tz)
             if best is None or key < best[0]:
                 best = (key, (tz, ty))
     return best[1] if best else (0, 0)
@@ -109,8 +129,8 @@ def substep_supported(spec: GridSpec, dtype) -> bool:
 
 
 class _SlabView:
-    """Adapter letting fd.field_data slice a (slot, field) slab of the VMEM
-    scratch ref as if it were a plain [z, y, x] array."""
+    """Adapter letting fd.field_data slice a field's plane window of the
+    VMEM scratch ref as if it were a plain [z, y, x] array."""
 
     __slots__ = ("ref", "pre")
 
@@ -149,10 +169,11 @@ def make_pallas_substep(
     n_tiles = n_tz * n_ty
     rows_in = ty + 16  # y window [y0-8, y0+ty+8): +-3 halo rows, 8-aligned
     H = _HALO
+    W = tz + 2 * H  # window planes per field
     beta = RK3_BETA[substep]
     alpha_over_pb = RK3_ALPHA[substep] / RK3_BETA[substep - 1] if substep else 0.0
     ids = tuple(float(v) for v in inv_ds)
-    # slab-local region the rates are produced over
+    # window-local region the rates are produced over
     rect = Rect3(Dim3(xo, 8, H), Dim3(xo + nx, 8 + ty, H + tz))
     xs = slice(xo, xo + nx)
 
@@ -160,60 +181,74 @@ def make_pallas_substep(
         curr_hbm = refs[:NF]
         oin_hbm = refs[NF : 2 * NF]
         out_hbm = refs[2 * NF : 3 * NF]
-        in_v, out_v, s_in, s_oin, s_out = refs[3 * NF :]
-        t = pl.program_id(0)
-        slot = t % 2  # in_v slot
+        win, stage, out_v, s_win, s_stage, s_oin, s_out = refs[3 * NF :]
+        yi = pl.program_id(0)
+        zi = pl.program_id(1)
+        t = yi * n_tz + zi
         s3 = t % 3  # out_v slot
         n3 = (t + 1) % 3
+        y0 = yo + yi * ty
+        z0 = zo + zi * tz
 
         def tile_zy(ti):
-            return zo + (ti // n_ty) * tz, yo + (ti % n_ty) * ty
+            return zo + (ti % n_tz) * tz, yo + (ti // n_tz) * ty
 
-        def in_dma(s, ti, f):
-            z0, y0 = tile_zy(ti)
+        def win_dma(f):
+            # full window for a strip's first tile: planes [z0-H, z0+tz+H)
             return pltpu.make_async_copy(
-                curr_hbm[f].at[pl.ds(z0 - H, tz + 2 * H), pl.ds(y0 - 8, rows_in)],
-                in_v.at[s, f],
-                s_in.at[s],
+                curr_hbm[f].at[pl.ds(z0 - H, W), pl.ds(y0 - 8, rows_in)],
+                win.at[f],
+                s_win,
             )
 
-        def oin_dma(s, ti, f):
-            z0, y0 = tile_zy(ti)
+        def stage_dma(sl, znext, f):
+            # fresh planes for tile znext of this strip: [z0' + H, z0' + tz + H)
             return pltpu.make_async_copy(
-                oin_hbm[f].at[pl.ds(z0, tz), pl.ds(y0, ty)],
-                out_v.at[s, f],
-                s_oin.at[s],
+                curr_hbm[f].at[
+                    pl.ds(zo + znext * tz + H, tz), pl.ds(y0 - 8, rows_in)
+                ],
+                stage.at[sl, f],
+                s_stage.at[sl],
             )
 
-        def out_dma(s, ti, f):
-            z0, y0 = tile_zy(ti)
+        def oin_dma(sl, ti, f):
+            tz0, ty0 = tile_zy(ti)
             return pltpu.make_async_copy(
-                out_v.at[s, f],
-                out_hbm[f].at[pl.ds(z0, tz), pl.ds(y0, ty)],
-                s_out.at[s],
+                oin_hbm[f].at[pl.ds(tz0, tz), pl.ds(ty0, ty)],
+                out_v.at[sl, f],
+                s_oin.at[sl],
             )
 
-        def start_in(s, ti):
+        def out_dma(sl, ti, f):
+            tz0, ty0 = tile_zy(ti)
+            return pltpu.make_async_copy(
+                out_v.at[sl, f],
+                out_hbm[f].at[pl.ds(tz0, tz), pl.ds(ty0, ty)],
+                s_out.at[sl],
+            )
+
+        # input pipeline: strip starts load the whole window; later tiles
+        # consume the stage prefetched during the previous tile
+        @pl.when(zi == 0)
+        def _():
             for f in range(NF):
-                in_dma(s, ti, f).start()
+                win_dma(f).start()
 
-        def start_oin(s, ti):
-            if substep:
+        @pl.when(zi + 1 < n_tz)
+        def _():
+            for f in range(NF):
+                stage_dma((zi + 1) % 2, zi + 1, f).start()
+
+        # oin prefetch (substep > 0): tile t+1's out-read into slot n3,
+        # which requires tile t-2's write-back (same slot) drained
+        if substep:
+            @pl.when(t == 0)
+            def _():
                 for f in range(NF):
-                    oin_dma(s, ti, f).start()
+                    oin_dma(s3, 0, f).start()
 
-        # pipeline: tile t+1's loads overlap tile t's compute
-        @pl.when(t == 0)
-        def _():
-            start_in(slot, t)
-            start_oin(s3, t)
-
-        @pl.when(t + 1 < n_tiles)
-        def _():
-            start_in((t + 1) % 2, t + 1)
-            if substep:
-                # out_v[(t+1)%3] was the write-back source of tile t-2
-                # ((t+1) - 3); that store must drain before reloading
+            @pl.when(t + 1 < n_tiles)
+            def _():
                 @pl.when(t >= 2)
                 def _():
                     for f in range(NF):
@@ -222,8 +257,22 @@ def make_pallas_substep(
                 for f in range(NF):
                     oin_dma(n3, t + 1, f).start()
 
-        for f in range(NF):
-            in_dma(slot, t, f).wait()
+        @pl.when(zi == 0)
+        def _():
+            for f in range(NF):
+                win_dma(f).wait()
+
+        @pl.when(zi > 0)
+        def _():
+            for f in range(NF):
+                stage_dma(zi % 2, zi, f).wait()
+            for f in range(NF):
+                # shift the window down by tz planes, then append the fresh
+                # planes (the RHS loads fully before the store, so the
+                # overlapping ranges are safe)
+                win[f, 0 : 2 * H] = win[f, tz : tz + 2 * H]
+                win[f, 2 * H : 2 * H + tz] = stage[zi % 2, f]
+
         if substep:
             for f in range(NF):
                 oin_dma(s3, t, f).wait()
@@ -237,7 +286,7 @@ def make_pallas_substep(
 
         # derivatives + physics over the tile, via the shared fd/equations
         # implementation (reference: solve<step>, user_kernels.h:437-469)
-        fds = [field_data(_SlabView(in_v, (slot, f)), rect, ids) for f in range(NF)]
+        fds = [field_data(_SlabView(win, (f,)), rect, ids) for f in range(NF)]
         lnrho, uux, uuy, uuz, ax, ay, az, ss = fds
         uu = (uux, uuy, uuz)
         aa = (ax, ay, az)
@@ -250,7 +299,7 @@ def make_pallas_substep(
         rates[7] = entropy(c, ss, uu, lnrho, aa)
 
         for f in range(NF):
-            curr_c = in_v[slot, f, H : H + tz, 8 : 8 + ty, :]
+            curr_c = win[f, H : H + tz, 8 : 8 + ty, :]
             if substep:
                 old = out_v[s3, f, :, :, xs]
                 new = curr_c[:, :, xs] + beta * (
@@ -281,20 +330,22 @@ def make_pallas_substep(
     )
     fn = pl.pallas_call(
         kernel,
-        grid=(n_tiles,),
+        grid=(n_ty, n_tz),
         out_shape=(shape,) * NF,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (2 * NF),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * NF,
         scratch_shapes=[
-            pltpu.VMEM((2, NF, tz + 2 * H, rows_in, px), jnp.float32),
+            pltpu.VMEM((NF, W, rows_in, px), jnp.float32),
+            pltpu.VMEM((2, NF, tz, rows_in, px), jnp.float32),
             pltpu.VMEM((3, NF, tz, ty, px), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((3,)),
             pltpu.SemaphoreType.DMA((3,)),
         ],
         input_output_aliases={NF + f: f for f in range(NF)},
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=("arbitrary", "arbitrary"),
             has_side_effects=True,
             vmem_limit_bytes=100 * 1024 * 1024,
         ),

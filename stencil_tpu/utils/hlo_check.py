@@ -1,0 +1,127 @@
+"""Machine check of the comm/compute-overlap dataflow structure.
+
+The TPU scheduler can only run a ``collective-permute`` concurrently with
+the interior compute if neither depends on the other — the property the
+reference achieves with streams + CPU polling (reference:
+src/stencil.cu:1002-1186, bin/jacobi3d.cu:296-368) and this framework
+achieves by construction (the fast-path kernel reads pre-exchange data).
+No hardware can *demonstrate* the overlap without a real multi-chip slice
+(BASELINE.md config 5), but the enabling dataflow property is checkable on
+any host: export the ≥2-device step for the TPU platform
+(``jax.export``), parse the StableHLO SSA graph, and verify that no
+``collective_permute`` transitively consumes the stencil kernel's output
+and the kernel consumes no ``collective_permute`` result.
+
+Used by tests/test_overlap_hlo.py (the machine gate) via the subprocess
+runner scripts/export_overlap_hlo.py, which is also the standalone entry.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+_ID_RE = re.compile(r"%[A-Za-z0-9_]+")
+
+
+def _main_body(mlir_text: str) -> List[str]:
+    """Lines of the @main function body (where shard_map'd steps inline)."""
+    lines = mlir_text.splitlines()
+    out: List[str] = []
+    depth = 0
+    in_main = False
+    for ln in lines:
+        if not in_main:
+            if re.search(r"func\.func .*@main\b", ln):
+                in_main = True
+                depth = ln.count("{") - ln.count("}")
+            continue
+        depth += ln.count("{") - ln.count("}")
+        out.append(ln)
+        if depth <= 0:
+            break
+    return out
+
+
+def build_graph(mlir_text: str) -> Dict[str, Tuple[str, List[str]]]:
+    """SSA graph of @main (including regions nested in it, e.g. the
+    ``sdy.manual_computation`` a shard_map lowers to): result id ->
+    (op line, operand ids on that line).
+
+    Parsing is per-line: every op this check cares about
+    (collective_permute, the Mosaic custom_call, slices/updates) is a
+    single-line op. Multi-result ops (``%a:2 = ...``) are keyed by their
+    base id; uses ``%a#1`` are normalized to ``%a``. Block arguments of
+    nested regions terminate closures (their binding to outer operands is
+    not tracked), which can only MISS dependence edges through region
+    boundaries — acceptable because the step under test is a single
+    straight-line iteration (no fori_loop), asserted by the caller seeing
+    the expected op counts.
+    """
+    graph: Dict[str, Tuple[str, List[str]]] = {}
+    for ln in _main_body(mlir_text):
+        m = re.match(r"^\s*(%[A-Za-z0-9_]+)(?::\d+)?\s*=\s*(.*)$", ln)
+        if not m:
+            continue
+        res, rhs = m.group(1), m.group(2)
+        operands = [t.split("#")[0] for t in _ID_RE.findall(rhs)]
+        graph[res] = (rhs, [o for o in operands if o != res])
+    return graph
+
+
+def _closure(graph, seeds: List[str]) -> Set[str]:
+    seen: Set[str] = set()
+    stack = list(seeds)
+    while stack:
+        s = stack.pop()
+        if s in seen or s not in graph:
+            continue
+        seen.add(s)
+        stack.extend(graph[s][1])
+    return seen
+
+
+def overlap_report(mlir_text: str, kernel_marker: str = "tpu_custom_call") -> dict:
+    """Analyze permute/kernel dataflow in an exported step.
+
+    Returns ``n_permutes``, ``n_kernels``, and the two independence
+    violations: ``permutes_consume_kernel`` (a collective transitively
+    reads a kernel result — comm serialized behind compute) and
+    ``kernels_consume_permutes`` (the kernel reads exchanged data — compute
+    serialized behind comm)."""
+    graph = build_graph(mlir_text)
+    permutes = [k for k, (op, _) in graph.items() if "collective_permute" in op]
+    kernels = [k for k, (op, _) in graph.items() if kernel_marker in op]
+    perm_inputs = _closure(graph, [o for p in permutes for o in graph[p][1]])
+    kernels_indep = [
+        k
+        for k in kernels
+        if not _closure(graph, graph[k][1]).intersection(permutes)
+    ]
+    return {
+        "n_permutes": len(permutes),
+        "n_kernels": len(kernels),
+        # a collective transitively reading a kernel result would serialize
+        # comm behind compute
+        "permutes_consume_kernel": bool(perm_inputs.intersection(kernels)),
+        # kernels free to run concurrently with the permutes (for RK3 this
+        # is substep 0; later substeps legitimately read exchanged data)
+        "n_kernels_independent_of_permutes": len(kernels_indep),
+    }
+
+
+def assert_overlap_independent(mlir_text: str, expect_permutes: int = None) -> dict:
+    """Raise AssertionError unless the permutes and the kernel are mutually
+    independent (the overlap-enabling dataflow)."""
+    rep = overlap_report(mlir_text)
+    assert rep["n_kernels"] >= 1, f"no stencil kernel found: {rep}"
+    assert rep["n_permutes"] >= 1, f"no collective_permute found: {rep}"
+    if expect_permutes is not None:
+        assert rep["n_permutes"] == expect_permutes, rep
+    assert not rep["permutes_consume_kernel"], (
+        f"collective_permute depends on a stencil kernel: {rep}"
+    )
+    assert rep["n_kernels_independent_of_permutes"] >= 1, (
+        f"every stencil kernel depends on collective_permute results: {rep}"
+    )
+    return rep

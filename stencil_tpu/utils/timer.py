@@ -34,13 +34,18 @@ def timed(bucket: str):
 
 @contextlib.contextmanager
 def trace_range(name: str):
-    """Named profiler range (NVTX analogue: jax.profiler.TraceAnnotation)."""
+    """Named profiler range (NVTX analogue: jax.profiler.TraceAnnotation).
+
+    Only the annotation setup is guarded — a body exception must propagate
+    (an ``except`` around the ``yield`` would swallow the throw and
+    double-yield: "generator didn't stop after throw()")."""
     try:
         import jax.profiler
 
-        with jax.profiler.TraceAnnotation(name):
-            yield
+        ann = jax.profiler.TraceAnnotation(name)
     except Exception:
+        ann = contextlib.nullcontext()
+    with ann:
         yield
 
 

@@ -374,3 +374,98 @@ def test_load_config_missing_extents_reports(tmp_path):
     info, ok = ac_config.load_config(str(p))
     assert not ok
     assert "AC_nx" in info.uninitialized()
+
+
+def test_distributed_pallas_overlap_2x2x2_matches_xla():
+    """Overlapped fused-Pallas path on a full 2x2x2 mesh (interpret mode),
+    two iterations: substep 0 runs from pre-exchange data concurrently
+    with the iteration's exchange, its multi-block shells re-integrated
+    after — must match the fp32 XLA path (VERDICT r2 item 2a). Two
+    iterations catch stale-halo reuse of the patched state."""
+    n = 32  # per-block 16^3: the smallest y-aligned Pallas-supported split
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    dt = 1e-3
+    size = Dim3(n, n, n)
+    rng = np.random.RandomState(3)
+    fields = {
+        k: (rng.randn(n, n, n) * 0.05).astype(np.float32) for k in FIELDS
+    }
+    fields["lnrho"] = fields["lnrho"] + np.float32(0.5)
+
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(3))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    ex = HaloExchange(spec, mesh)
+
+    outs = {}
+    for label, kwargs in (
+        ("pallas", dict(use_pallas=True, interpret=True)),
+        ("xla", dict(use_pallas=False)),
+    ):
+        step = make_astaroth_step(
+            ex, info, dt=dt, overlap=True, dtype="float32", **kwargs
+        )
+        curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
+        nxt = {
+            k: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
+            for k in FIELDS
+        }
+        for _ in range(2):
+            curr, nxt = step(curr, nxt)
+        outs[label] = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
+
+    for k in FIELDS:
+        np.testing.assert_allclose(
+            outs["pallas"][k], outs["xla"][k], rtol=1e-5, atol=1e-7, err_msg=k
+        )
+
+
+def test_distributed_pallas_overlap_mixed_mesh_matches_xla():
+    """Regression (r3 review): a mesh with BOTH a multi-block axis and
+    self-wrap axes, e.g. z split over 2 devices with y/x periodic onto
+    themselves. Substep 0's kernel pass reads pre-exchange halos on every
+    axis and this kernel has no in-kernel wrap, so the overlap patch must
+    re-integrate shells on ALL sides — covering only multi-block sides
+    corrupted the self-wrap boundaries (max err ~0.22 at 32^3)."""
+    n = 32
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    dt = 1e-3
+    size = Dim3(n, n, n)
+    rng = np.random.RandomState(5)
+    fields = {
+        k: (rng.randn(n, n, n) * 0.05).astype(np.float32) for k in FIELDS
+    }
+    fields["lnrho"] = fields["lnrho"] + np.float32(0.5)
+
+    spec = GridSpec(size, Dim3(1, 1, 2), Radius.constant(3))  # z split only
+    mesh = grid_mesh(spec.dim, jax.devices()[:2])
+    ex = HaloExchange(spec, mesh)
+
+    outs = {}
+    for label, kwargs in (
+        ("pallas", dict(use_pallas=True, interpret=True)),
+        ("xla", dict(use_pallas=False)),
+    ):
+        step = make_astaroth_step(
+            ex, info, dt=dt, overlap=True, dtype="float32", **kwargs
+        )
+        curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
+        nxt = {
+            k: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
+            for k in FIELDS
+        }
+        for _ in range(2):
+            curr, nxt = step(curr, nxt)
+        outs[label] = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
+
+    for k in FIELDS:
+        np.testing.assert_allclose(
+            outs["pallas"][k], outs["xla"][k], rtol=1e-5, atol=1e-7, err_msg=k
+        )

@@ -1,0 +1,66 @@
+"""Machine check: the compiled multi-block fast-path step's dataflow
+permits comm/compute overlap (VERDICT r2 item 2b).
+
+Each step is exported for the TPU platform (jax.export runs the full
+Mosaic kernel lowering without hardware), then the StableHLO SSA graph is
+analyzed: the collective_permutes must not transitively consume any
+stencil-kernel result, and at least one kernel must be independent of
+every permute. A negative control (the non-overlapped step) proves the
+checker actually distinguishes the structures.
+
+The export runs in a subprocess (scripts/export_overlap_hlo.py): JAX's
+lowering recursion blows the stack when invoked under pytest's
+assertion-rewritten frames, and a clean interpreter sidesteps it — the
+same self-provisioning trick __graft_entry__.dryrun_multichip uses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "scripts", "export_overlap_hlo.py")
+
+
+def _report(which: str) -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, which],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=_REPO,
+    )
+    assert proc.returncode == 0, f"{which}: {proc.stderr[-3000:]}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_jacobi_pallas_overlap_dataflow():
+    rep = _report("jacobi-overlap")
+    assert rep["n_permutes"] == 6
+    assert rep["n_kernels"] == 1
+    assert not rep["permutes_consume_kernel"]
+    assert rep["n_kernels_independent_of_permutes"] == 1
+
+
+def test_checker_flags_non_overlapped_step():
+    """Negative control: exchange-then-sweep must FAIL the independence
+    check (the kernel consumes permute results)."""
+    rep = _report("jacobi-serial")
+    assert rep["n_permutes"] == 6
+    assert rep["n_kernels"] == 1
+    assert rep["n_kernels_independent_of_permutes"] == 0
+
+
+def test_astaroth_pallas_overlap_dataflow():
+    rep = _report("astaroth-overlap")
+    # 6 permutes (2 per axis phase) x 8 quantities
+    assert rep["n_permutes"] == 48
+    # 3 substep kernels; substep 0 (pre-exchange input) is the free one
+    assert rep["n_kernels"] == 3
+    assert not rep["permutes_consume_kernel"]
+    assert rep["n_kernels_independent_of_permutes"] == 1

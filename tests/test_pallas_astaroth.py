@@ -132,6 +132,6 @@ def test_pick_tiles_budget():
     tz, ty = pick_tiles(spec)
     assert tz >= 1 and ty % 8 == 0
     assert 256 % tz == 0 and 256 % ty == 0
-    p = spec.padded()
-    scratch = (2 * 8 * (tz + 6) * (ty + 16) + 3 * 8 * tz * ty) * p.x * 4
-    assert scratch <= 22 * 1024 * 1024
+    from stencil_tpu.ops.pallas_astaroth import _SCRATCH_BUDGET, scratch_bytes
+
+    assert scratch_bytes(spec, tz, ty) <= _SCRATCH_BUDGET
