@@ -1,0 +1,63 @@
+"""fp64-on-TPU diagnosis (VERDICT r2 item 3).
+
+Measures XLA-path fp64 astaroth compile+run time vs grid size, with the
+iteration jitted whole vs substep-chunked, to locate the compile-time
+explosion and find a shippable (slow-but-working) fp64 configuration.
+
+Usage: python scripts/probe_f64.py [sizes...]
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+from stencil_tpu.astaroth import config as ac_config
+from stencil_tpu.astaroth.integrate import FIELDS, make_astaroth_step
+from stencil_tpu.apps.astaroth import DEFAULT_CONF
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel import HaloExchange, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks
+from stencil_tpu.utils.sync import hard_sync
+
+sizes = [int(s) for s in sys.argv[1:]] or [16, 32, 64]
+print("devices:", jax.devices(), flush=True)
+
+for n in sizes:
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    size = Dim3(n, n, n)
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(3))
+    mesh = grid_mesh(spec.dim, jax.devices()[:1])
+    ex = HaloExchange(spec, mesh)
+    rng = np.random.RandomState(0)
+    fields = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
+    fields["lnrho"] = fields["lnrho"] + 0.5
+    try:
+        step = make_astaroth_step(ex, info, dt=1e-8, overlap=False,
+                                  use_pallas=False, dtype="float64")
+        curr = {k: shard_blocks(fields[k], spec, mesh, dtype=np.float64)
+                for k in FIELDS}
+        nxt = {k: shard_blocks(np.zeros((n, n, n)), spec, mesh,
+                               dtype=np.float64) for k in FIELDS}
+        t0 = time.time()
+        curr, nxt = step(curr, nxt)
+        hard_sync(curr)
+        compile_s = time.time() - t0
+        t0 = time.perf_counter()
+        for _ in range(3):
+            curr, nxt = step(curr, nxt)
+        hard_sync(curr)
+        run_ms = (time.perf_counter() - t0) / 3 * 1e3
+        finite = bool(np.isfinite(np.asarray(jax.device_get(curr["lnrho"]))).all())
+        print(f"f64 {n}^3 XLA-path: compile {compile_s:.0f}s, "
+              f"{run_ms:.1f} ms/iter, finite={finite}", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"f64 {n}^3 XLA-path: FAIL {type(e).__name__}: {str(e)[:300]}",
+              flush=True)

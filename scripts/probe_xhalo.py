@@ -1,0 +1,181 @@
+"""Out-of-line x-halo layout experiment (VERDICT r2 item 4).
+
+The aligned layout pads a 512-wide radius-1 row to 640 lanes (off.x=1 plus
+round-up), so every slab DMA moves 1.25x the logical bytes — the one-step
+sweep's x-amplification. This probe benchmarks a TIGHT-x variant: blocks
+stored (pz, py, nx) with NO inline x halos (px == nx), the periodic x
+neighborhood formed by in-VMEM lane rolls (the single-chip limit of the
+reference's out-of-line pack buffers, src/packer.cu:66-107). Both variants
+run the same pipelined double-buffered DMA structure, no sphere sel, so
+the delta isolates the layout.
+
+Usage: python scripts/probe_xhalo.py [n]
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+TZ, TY = 2, 128
+
+
+def make_sweep(px, xo, tight):
+    """One radius-1 jacobi sweep over (pz, py, px); z/y/x periodic.
+    ``tight``: px == nx, x wrap via lane rolls; else inline x halo at
+    [xo-1, xo+nx] with in-VMEM edge-column copies (the production layout).
+    z wrap via wrapped plane DMAs is replaced by torus indexing on the
+    grid (identical traffic); y wrap by row copies as in the production
+    kernel (full-row slabs not used: ty=128 tiling, wrap rows staged)."""
+    nz = ny = nx = n
+    pz, py = n + 2, ((8 + n + 1 + 7) // 8) * 8
+    yo, zo = 8, 1
+    n_tz, n_ty = nz // TZ, ny // TY
+    n_tiles = n_tz * n_ty
+    rows_in = TY + 16
+
+    def kernel(curr, out_hbm, in_v, out_v, wy_v, s_in, s_out, s_w):
+        t = pl.program_id(0)
+        slot, nslot = t % 2, (t + 1) % 2
+
+        def tile_zy(ti):
+            return zo + (ti // n_ty) * TZ, yo + (ti % n_ty) * TY
+
+        def in_dma(s, ti):
+            z0, y0 = tile_zy(ti)
+            return pltpu.make_async_copy(
+                curr.at[pl.ds(z0 - 1, TZ + 2), pl.ds(y0 - 8, rows_in)],
+                in_v.at[s], s_in.at[s])
+
+        def out_dma(s, ti):
+            z0, y0 = tile_zy(ti)
+            return pltpu.make_async_copy(
+                out_v.at[s], out_hbm.at[pl.ds(z0, TZ), pl.ds(y0, TY)],
+                s_out.at[s])
+
+        @pl.when(t == 0)
+        def _():
+            in_dma(slot, t).start()
+
+        @pl.when(t + 1 < n_tiles)
+        def _():
+            in_dma(nslot, t + 1).start()
+
+        in_dma(slot, t).wait()
+
+        z0, y0 = tile_zy(t)
+        zi, yi = t // n_ty, t % n_ty
+        # z wrap: edge tiles refetch the opposite face plane
+        @pl.when(zi == 0)
+        def _():
+            cp = pltpu.make_async_copy(
+                curr.at[pl.ds(zo + nz - 1, 1), pl.ds(y0 - 8, rows_in)],
+                in_v.at[slot, pl.ds(0, 1)], s_w)
+            cp.start(); cp.wait()
+
+        @pl.when(zi == n_tz - 1)
+        def _():
+            cp = pltpu.make_async_copy(
+                curr.at[pl.ds(zo, 1), pl.ds(y0 - 8, rows_in)],
+                in_v.at[slot, pl.ds(TZ + 1, 1)], s_w)
+            cp.start(); cp.wait()
+
+        # y wrap rows staged through scratch
+        @pl.when(yi == 0)
+        def _():
+            cp = pltpu.make_async_copy(
+                curr.at[pl.ds(z0, TZ), pl.ds(yo + ny - 8, 8)], wy_v, s_w)
+            cp.start(); cp.wait()
+            in_v[slot, 1:TZ + 1, 7, :] = wy_v[:, 7, :]
+
+        @pl.when(yi == n_ty - 1)
+        def _():
+            cp = pltpu.make_async_copy(
+                curr.at[pl.ds(z0, TZ), pl.ds(yo, 8)], wy_v, s_w)
+            cp.start(); cp.wait()
+            in_v[slot, 1:TZ + 1, 8 + TY, :] = wy_v[:, 0, :]
+
+        ctr = slice(8, 8 + TY)
+        c = in_v[slot, 1:TZ + 1]
+        if tight:
+            mid = c[:, ctr, :]
+            xm = pltpu.roll(mid, 1, 2)   # col j reads j-1 (wraps)
+            xp = pltpu.roll(mid, -1, 2)  # col j reads j+1 (wraps)
+            avg = (xm + xp
+                   + c[:, 7:7 + TY, :] + c[:, 9:9 + TY, :]
+                   + in_v[slot, 0:TZ, ctr, :] + in_v[slot, 2:TZ + 2, ctr, :]
+                   ) / 6.0
+            out_v[slot] = avg
+        else:
+            in_v[slot, :, :, xo - 1] = in_v[slot, :, :, xo + nx - 1]
+            in_v[slot, :, :, xo + nx] = in_v[slot, :, :, xo]
+            xs = slice(xo, xo + nx)
+            avg = (c[:, ctr, xo - 1:xo + nx - 1] + c[:, ctr, xo + 1:xo + nx + 1]
+                   + c[:, 7:7 + TY, xs] + c[:, 9:9 + TY, xs]
+                   + in_v[slot, 0:TZ, ctr, xs] + in_v[slot, 2:TZ + 2, ctr, xs]
+                   ) / 6.0
+            out_v[slot] = c[:, ctr, :]
+            out_v[slot, :, :, xs] = avg
+
+        @pl.when(t >= 2)
+        def _():
+            out_dma(slot, t - 2).wait()
+        out_dma(slot, t).start()
+
+        @pl.when(t == n_tiles - 1)
+        def _():
+            if n_tiles >= 2:
+                out_dma(nslot, t - 1).wait()
+            out_dma(slot, t).wait()
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        out_shape=jax.ShapeDtypeStruct((pz, py, px), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, TZ + 2, rows_in, px), jnp.float32),
+            pltpu.VMEM((2, TZ, TY, px), jnp.float32),
+            pltpu.VMEM((TZ, 8, px), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), has_side_effects=True,
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )
+
+
+def bench(label, px, xo, tight):
+    pz, py = n + 2, ((8 + n + 1 + 7) // 8) * 8
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.rand(pz, py, px), jnp.float32)
+    fn = make_sweep(px, xo, tight)
+    chunk = 120
+
+    def many(a):
+        def body(_, cn):
+            c, nxt_ = cn
+            return (fn(c), c)
+        return jax.lax.fori_loop(0, chunk, body, (a, a))[0]
+
+    g = jax.jit(many)
+    t0 = time.time(); r = g(x0); hard_sync(r)
+    cs = time.time() - t0
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter(); r = g(r); hard_sync(r)
+        st.insert((time.perf_counter() - t0) / chunk)
+    ms = st.trimean() * 1e3
+    print(f"{label}: {ms:.3f} ms/step = {n**3/st.trimean()/1e6:.0f} Mcells/s "
+          f"(compile {cs:.0f}s)", flush=True)
+
+
+print("devices:", jax.devices(), flush=True)
+bench("inline-x (px=640)", ((1 + n + 1 + 127) // 128) * 128, 1, False)
+bench(f"tight-x (px={n})", n, 0, True)

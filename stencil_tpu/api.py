@@ -127,14 +127,32 @@ class DistributedDomain:
                 hosts = max(1, jax.process_count())
                 part = NodePartition(self.size, self.radius, hosts, max(1, n // hosts))
                 dim = part.dim()
+            mesh_dim = dim
             if dim.flatten() != n:
-                raise ValueError(
-                    f"partition {dim} needs {dim.flatten()} devices, have {n}"
-                )
+                # oversubscription (reference: dd.set_gpus({0,0}),
+                # stencil.hpp:154): run any partition on fewer devices by
+                # stacking c z-blocks per device; the exchange shifts
+                # resident-neighbor slabs locally (exchange.py
+                # _axis_phase_resident)
+                c, rem = divmod(dim.flatten(), n)
+                if rem or dim.z % c:
+                    raise ValueError(
+                        f"partition {dim} needs {dim.flatten()} devices (or a "
+                        f"z extent divisible by blocks-per-device), have {n}"
+                    )
+                mesh_dim = Dim3(dim.x, dim.y, dim.z // c)
             self.spec = GridSpec(self.size, dim, self.radius)
-            if self._placement is not None:
+            if self._placement is not None and mesh_dim != dim:
+                log.warn(
+                    "placement strategies assume one block per device; "
+                    "ignoring set_placement for the oversubscribed partition"
+                )
+            if self._placement is not None and mesh_dim == dim:
                 devices = self._placement.arrange(devices, self.spec)
-            self.mesh = grid_mesh(dim, devices, ordered=self._placement is not None)
+            self.mesh = grid_mesh(
+                mesh_dim, devices,
+                ordered=self._placement is not None and mesh_dim == dim,
+            )
         self.time_plan = time.perf_counter() - t0
 
         t0 = time.perf_counter()

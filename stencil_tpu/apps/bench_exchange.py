@@ -50,13 +50,14 @@ def sweep_radii(face: int = 2, edge: int = 1):
     ]
 
 
-def run(x, y, z, iters=30, quantities=4, devices=None, method=Method.AXIS_COMPOSED):
+def run(x, y, z, iters=30, quantities=4, devices=None, method=Method.AXIS_COMPOSED,
+        chunk=10):
     devices = list(devices) if devices is not None else jax.devices()
     rows = []
     for name, radius in sweep_radii():
         r = time_exchange(
             Dim3(x, y, z), radius, iters, method=method, devices=devices,
-            quantities=quantities,
+            quantities=quantities, chunk=chunk,
         )
         rows.append(
             {

@@ -37,6 +37,7 @@ def run(
     weak: bool = True,
     radius: int = 3,
     prefix: str = "",
+    chunk: int = 10,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     size = weak_scale(x, y, z, len(devices)) if weak else Dim3(x, y, z)
@@ -49,6 +50,7 @@ def run(
         placement=placement_from_flags(naive, random_),
         quantities=4,
         prefix=prefix,
+        chunk=chunk,
     )
     r.update(
         app="exchange",

@@ -1,0 +1,230 @@
+"""weak_scaling — the day-1 multi-chip harness for the north-star table.
+
+Given an N-chip slice this runs the three BASELINE.json multi-chip configs
+and emits one CSV plus weak-scaling efficiencies against recorded
+single-chip numbers, so the first hardware session produces the scaling
+table instead of engineering (reference workflow:
+scripts/summit/512node_weak_exchange.sh:17-29 — one submission per scale,
+CSV rows appended per run):
+
+- config 2: exchange, 256^3 *global*, radius 2, 4 quantities (2x2x2
+  partition at 8 chips; whatever partition N chips realize otherwise)
+- config 3: exchange_weak, 512^3 *per chip*, radius 3, 4 quantities
+- config 5: jacobi3d overlap step, 256^3 per chip (1024^3 global at 64
+  chips), plus the measure_overlap hidden-fraction instrument at the same
+  per-chip size
+
+Efficiency definitions (vs the ``--base`` JSON, by default the repo's
+recorded single-chip numbers, re-recordable with ``--record-base`` on one
+chip):
+
+- jacobi:   eff = (Mcells/s/chip at N) / (Mcells/s/chip at 1) — the >90%
+            north star (BASELINE.json).
+- exchange: t(1 chip)/t(N chips) per exchange at the same per-chip load
+            (config 3); reported as a ratio, not a percentage, because the
+            1-chip "exchange" is self-wrap halo fill, a different physical
+            operation than ICI permutes — the absolute GB/s column is the
+            number that matters.
+- overlap:  hidden_frac from measure_overlap (1.0 = exchange fully hidden).
+
+Usage:
+  python -m stencil_tpu.apps.weak_scaling                  # real chips
+  python -m stencil_tpu.apps.weak_scaling --cpu 8 --smoke  # virtual mesh
+  python -m stencil_tpu.apps.weak_scaling --record-base    # on 1 chip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+import jax
+
+from ..geometry import Dim3
+from ..parallel import Method
+from ..utils import logging as log
+from . import bench_exchange, exchange_weak, jacobi3d, measure_overlap
+
+# Single-chip anchors (v5e, round-3 measurements; see BASELINE.md).
+# --record-base overwrites these with freshly measured values. The jacobi
+# anchor is the 256^3-per-chip config-5 configuration itself (fused loop,
+# deep_halo=4), NOT the 512^3 headline, so the efficiency column compares
+# like with like.
+DEFAULT_BASE = {
+    "jacobi_mcells_per_s_per_dev": 13216.0,  # 256^3 deep_halo=4 fused loop
+    "exchange_weak_trimean_s": 5.21e-3,      # 512^3 radius-3 4q self-wrap fill
+    "config2_trimean_s": 2.21e-3,            # 256^3 radius-2 4q self-wrap fill
+}
+
+
+def _base_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "scripts", "weak_base.json")
+
+
+def run(
+    devices=None,
+    iters: int = 30,
+    jacobi_iters: int = 60,
+    per_chip: Dim3 = Dim3(256, 256, 256),
+    exw_per_chip: Dim3 = Dim3(512, 512, 512),
+    config2_global: Dim3 = Dim3(256, 256, 256),
+    base: Optional[dict] = None,
+    use_pallas: Optional[bool] = None,
+    overlap_rounds: int = 3,
+    deep_halo: int = 4,
+    chunk: int = 10,
+) -> dict:
+    """Run configs 2/3/5 on ``devices`` and return rows + efficiencies."""
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    base = dict(DEFAULT_BASE, **(base or {}))
+    rows = []
+
+    # -- config 2: fixed global exchange ------------------------------------
+    c2 = bench_exchange.run(
+        config2_global.x, config2_global.y, config2_global.z,
+        iters=iters, quantities=4, devices=devices, chunk=chunk,
+    )[-1]  # the "uniform/2" row — config 2's radius-2 halo
+    c2_eff = base["config2_trimean_s"] / c2["trimean_s"]
+    rows.append(("config2_exchange", config2_global.x, config2_global.y,
+                 config2_global.z, n, c2["trimean_s"],
+                 c2["bytes_per_s"] / 1e9, c2_eff))
+
+    # -- config 3: weak-scaled exchange -------------------------------------
+    c3 = exchange_weak.run(
+        exw_per_chip.x, exw_per_chip.y, exw_per_chip.z,
+        iters=iters, devices=devices, weak=True, chunk=chunk,
+    )
+    c3_eff = base["exchange_weak_trimean_s"] / c3["trimean_s"]
+    rows.append(("config3_exchange_weak", c3["x"], c3["y"], c3["z"], n,
+                 c3["trimean_s"], c3["gb_per_s"], c3_eff))
+
+    # -- config 5: overlapped jacobi + hidden fraction ----------------------
+    # deep_halo lets the fused loop temporally block across chips (one
+    # radius-k exchange per k steps); the anchor is a 256^3 single-chip run
+    # of the SAME configuration so the efficiency column measures scaling,
+    # not temporal-blocking availability
+    c5 = jacobi3d.run(
+        per_chip.x, per_chip.y, per_chip.z,
+        iters=jacobi_iters, overlap=True, devices=devices, weak=True,
+        deep_halo=deep_halo, chunk=min(chunk, jacobi_iters),
+    )
+    jac_eff = c5["mcells_per_s_per_dev"] / base["jacobi_mcells_per_s_per_dev"]
+    rows.append(("config5_jacobi_overlap", c5["x"], c5["y"], c5["z"], n,
+                 c5["iter_trimean_s"], c5["mcells_per_s_per_dev"], jac_eff))
+
+    ov = measure_overlap.run(
+        per_chip.x, per_chip.y, per_chip.z,
+        radius=1, iters=max(10, iters // 3), rounds=overlap_rounds,
+        devices=devices, weak=True, use_pallas=use_pallas,
+    )
+    rows.append(("config5_hidden_frac", ov["x"], ov["y"], ov["z"], n,
+                 ov["overlap_s"], ov["hidden_s"], ov["hidden_frac"]))
+
+    return {
+        "devices": n,
+        "rows": rows,
+        "results": {"config2": c2, "config3": c3, "config5": c5,
+                    "overlap": ov},
+    }
+
+
+# `metric` is per-row heterogeneous (GB/s for the exchange configs,
+# Mcells/s/chip for jacobi, hidden seconds for the overlap instrument) —
+# rows are keyed by `config`, so never aggregate the column across rows.
+CSV_HEADER = "config,x,y,z,devices,seconds,metric,efficiency"
+
+
+def csv_rows(res: dict) -> list:
+    out = [CSV_HEADER]
+    for name, x, y, z, n, secs, thr, eff in res["rows"]:
+        out.append(f"{name},{x},{y},{z},{n},{secs:e},{thr:.3f},{eff:.4f}")
+    return out
+
+
+def record_base(devices=None, iters: int = 360, path: str = "") -> dict:
+    """Measure the single-chip anchors and write them to ``path``.
+
+    Large fused chunks: the tunneled single-chip platform pays ~87 ms per
+    dispatch, which would dominate any per-10-iteration chunk (a first
+    recording with chunk 10 read 5x slow across the board)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    assert len(devices) == 1, "--record-base wants exactly one device"
+    chunk = max(1, iters // 3)
+    c2 = bench_exchange.run(256, 256, 256, iters=iters, quantities=4,
+                            devices=devices, chunk=chunk)[-1]  # "uniform/2"
+    c3 = exchange_weak.run(512, 512, 512, iters=iters, devices=devices,
+                           chunk=chunk)
+    # same shape as run()'s config 5: 256^3 per chip, deep_halo fused loop
+    c5 = jacobi3d.run(256, 256, 256, iters=iters, overlap=True,
+                      devices=devices, weak=False, deep_halo=4, chunk=chunk)
+    base = {
+        "jacobi_mcells_per_s_per_dev": c5["mcells_per_s_per_dev"],
+        "exchange_weak_trimean_s": c3["trimean_s"],
+        "config2_trimean_s": c2["trimean_s"],
+    }
+    path = path or _base_path()
+    with open(path, "w") as f:
+        json.dump(base, f, indent=1)
+    log.info(f"single-chip base recorded to {path}: {base}")
+    return base
+
+
+def main(argv: Optional[list] = None) -> int:
+    from ..parallel.distributed import maybe_init_from_env
+    maybe_init_from_env()
+    p = argparse.ArgumentParser(description="weak-scaling day-1 harness")
+    p.add_argument("--cpu", type=int, default=0, help="virtual CPU devices")
+    p.add_argument("--iters", type=int, default=None,
+                   help="timed iterations (default 30; 360 for --record-base "
+                        "— anchors need large fused chunks on the tunneled "
+                        "single chip)")
+    p.add_argument("--jacobi-iters", type=int, default=60)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny sizes for the virtual-mesh smoke test")
+    p.add_argument("--base", default="", help="single-chip anchors JSON")
+    p.add_argument("--record-base", action="store_true",
+                   help="measure + write the single-chip anchors (1 chip)")
+    p.add_argument("--out", default="", help="also append CSV to this file")
+    p.add_argument("--pallas", dest="use_pallas", action="store_true",
+                   default=None, help="force the Pallas overlap variant")
+    args = p.parse_args(argv)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+
+    if args.record_base:
+        record_base(iters=args.iters or 360, path=args.base)
+        return 0
+
+    base = None
+    base_path = args.base or _base_path()
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)
+
+    kw = {}
+    if args.smoke:
+        kw = dict(per_chip=Dim3(32, 32, 32), exw_per_chip=Dim3(32, 32, 32),
+                  config2_global=Dim3(32, 32, 32), iters=4, jacobi_iters=4,
+                  overlap_rounds=1)
+    else:
+        kw = dict(iters=args.iters or 30, jacobi_iters=args.jacobi_iters)
+    res = run(base=base, use_pallas=args.use_pallas, **kw)
+
+    lines = csv_rows(res)
+    for line in lines:
+        print(line)
+    if args.out:
+        new = not os.path.exists(args.out)
+        with open(args.out, "a") as f:
+            for line in lines if new else lines[1:]:
+                f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

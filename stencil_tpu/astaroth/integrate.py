@@ -32,9 +32,10 @@ from __future__ import annotations
 from typing import Dict, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
-from ..geometry import Rect3, exterior_regions, interior_region
+from ..geometry import Dim3, Rect3, exterior_regions, interior_region
 from ..parallel.exchange import BLOCK_PSPEC, HaloExchange
 from .config import AcMeshInfo
 from .equations import Constants, continuity, entropy, induction, momentum
@@ -79,10 +80,14 @@ def _integrate_region(
     dt,
     curr: Dict[str, jax.Array],
     out: Dict[str, jax.Array],
+    mask=None,
 ) -> Dict[str, jax.Array]:
     """Integrate one region: read curr fields' derivatives over ``rect``,
     RK3-update the region in the out buffers (reference: solve<step> kernel,
-    user_kernels.h:437-469)."""
+    user_kernels.h:437-469). ``mask`` (broadcastable to the region) keeps
+    ``out``'s prior value where False — the masked-interior write of the
+    uneven-partition overlap path (shell extents are per-block there, so
+    the interior cannot be a static shrunk rect)."""
     lnrho = field_data(curr["lnrho"], rect, inv_ds)
     uu = tuple(field_data(curr[k], rect, inv_ds) for k in ("uux", "uuy", "uuz"))
     aa = tuple(field_data(curr[k], rect, inv_ds) for k in ("ax", "ay", "az"))
@@ -101,14 +106,52 @@ def _integrate_region(
     new_out = {}
     for k in FIELDS:
         updated = rk3_integrate(substep, out[k][sl], curr[k][sl], rates[k], dt)
+        if mask is not None:
+            updated = jnp.where(mask, updated, out[k][sl])
         new_out[k] = out[k].at[sl].set(updated.astype(out[k].dtype))
     return new_out
 
 
+def _integrate_region_dyn(spec, substep, lo, size, inv_ds, c, dt, curr, out,
+                          out_read=None):
+    """Integrate one dynamic-offset boundary shell ``[lo, lo + size)``
+    (allocation-local z/y/x, ``lo`` may be traced — uneven partitions): the
+    exterior pass when per-block extents are static only per block index.
+    Slices a (size + 2·3)-halo slab of every field, runs the same
+    :func:`_integrate_region` math over it, and writes the core back.
+
+    ``out_read`` is the state_previous source. Dynamic shells overlap at
+    edges/corners (cross-sections span the base extents), so all patches of
+    one substep must read the SAME pre-patch out — overlapping writes then
+    compute identical values, where reading the accumulating ``out`` would
+    double-apply the RK3 stage at overlap cells for substeps > 0."""
+    h = 3
+    p = spec.padded()
+    slab_lo = (lo[0] - h, lo[1] - h, lo[2] - h)
+    slab_sz = (size[0] + 2 * h, size[1] + 2 * h, size[2] + 2 * h)
+
+    def slab(a):
+        return lax.dynamic_slice(a.reshape(p.z, p.y, p.x), slab_lo, slab_sz)
+
+    curr_s = {k: slab(v) for k, v in curr.items()}
+    out_s = {k: slab(v) for k, v in (out_read or out).items()}
+    rect = Rect3(Dim3(h, h, h), Dim3(h + size[2], h + size[1], h + size[0]))
+    new_s = _integrate_region(substep, rect, inv_ds, c, dt, curr_s, out_s)
+    core = (slice(h, h + size[0]), slice(h, h + size[1]), slice(h, h + size[2]))
+    res = {}
+    for k in FIELDS:
+        o3 = out[k].reshape(p.z, p.y, p.x)
+        res[k] = lax.dynamic_update_slice(o3, new_s[k][core], lo).reshape(
+            out[k].shape
+        )
+    return res
+
+
 def uses_pallas(ex: HaloExchange, use_pallas, dtype="float32") -> bool:
     """Whether :func:`make_astaroth_step` will take the fused Pallas path
-    for fields of ``dtype`` (None = auto: TPU, fp32, uniform aligned
-    blocks)."""
+    for fields of ``dtype`` (None = auto: TPU, fp32, aligned blocks, no
+    resident oversubscription; uneven partitions run the kernel over the
+    padded base extents with dynamic-shell overlap)."""
     if use_pallas is not None:
         return bool(use_pallas)
     import jax.numpy as jnp
@@ -118,7 +161,7 @@ def uses_pallas(ex: HaloExchange, use_pallas, dtype="float32") -> bool:
     devs = ex.mesh.devices.flatten()
     return (
         all(d.platform == "tpu" for d in devs)
-        and ex.spec.is_uniform()
+        and ex.resident_z == 1
         and substep_supported(ex.spec, jnp.dtype(dtype))
     )
 
@@ -167,6 +210,18 @@ def make_astaroth_step(
     interior = interior_region(compute, r)
     exteriors = exterior_regions(compute, interior)
     use_overlap = overlap and spec.is_uniform()
+    # uneven partitions keep the overlap structure via per-block dynamic
+    # geometry (ops/shells.py): masked interior write + dynamic-offset
+    # shells, the analogue of the reference's per-LocalDomain regions
+    # (src/stencil.cu:878-977)
+    use_dyn_overlap = overlap and not spec.is_uniform()
+
+    def _dyn_geometry():
+        from ..ops.shells import dyn_block_sizes, interior_mask, shell_regions
+
+        sizes = dyn_block_sizes(spec)
+        inc = (True, True, True)  # pre-exchange halos are stale on all sides
+        return interior_mask(spec, sizes, inc), shell_regions(spec, sizes, inc)
 
     if uses_pallas(ex, use_pallas, dtype):
         from ..ops.pallas_astaroth import make_pallas_substep
@@ -226,6 +281,17 @@ def make_astaroth_step(
                 curr = exchange_all(curr)
                 for rect in exteriors:
                     out = _integrate_region(0, rect, inv_ds, c, dt, curr, out)
+            elif use_dyn_overlap:
+                # uneven partition: same structure, shells at per-block
+                # dynamic offsets (substep 0 never reads out, so the full
+                # kernel pass before the shells is exact)
+                out = untuple(kernels[0](to3(curr), to3(out)), out)
+                curr = exchange_all(curr)
+                _, shells = _dyn_geometry()
+                for lo, size in shells:
+                    out = _integrate_region_dyn(
+                        spec, 0, lo, size, inv_ds, c, dt, curr, out
+                    )
             else:
                 curr = exchange_all(curr)
                 out = untuple(kernels[0](to3(curr), to3(out)), out)
@@ -240,6 +306,21 @@ def make_astaroth_step(
                 curr = {k: ex.exchange_block(v) for k, v in curr.items()}
                 for rect in exteriors:
                     out = _integrate_region(substep, rect, inv_ds, c, dt, curr, out)
+            elif use_dyn_overlap:
+                # masked interior write (shell cells keep the pre-update out
+                # that substeps > 0 read as state_previous), exchange, then
+                # dynamic-offset shells from the exchanged halos
+                imask, shells = _dyn_geometry()
+                out = _integrate_region(
+                    substep, compute, inv_ds, c, dt, curr, out, mask=imask
+                )
+                curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+                out_read = out
+                for lo, size in shells:
+                    out = _integrate_region_dyn(
+                        spec, substep, lo, size, inv_ds, c, dt, curr, out,
+                        out_read=out_read,
+                    )
             else:
                 curr = {k: ex.exchange_block(v) for k, v in curr.items()}
                 out = _integrate_region(substep, compute, inv_ds, c, dt, curr, out)

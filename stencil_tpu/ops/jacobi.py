@@ -27,6 +27,7 @@ from typing import Optional, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..geometry import Dim3, Radius, Rect3, exterior_regions, interior_region
 from ..parallel.exchange import BLOCK_PSPEC, HaloExchange
@@ -63,6 +64,45 @@ def jacobi_sweep(src, out, rect: Rect3, masks=None):
     return out.at[(..., *_rect_slices(rect))].set(avg.astype(out.dtype))
 
 
+def _sweep_slab_dyn(src3, o3, sel3, lo, size):
+    """Re-sweep one dynamic-offset boundary shell ``[lo, lo + size)`` of a
+    (pz, py, px) block from exchanged data ``src3`` into ``o3``. ``size`` is
+    static; ``lo`` entries may be traced (uneven-partition hi-side shells).
+    Bit-parity with :func:`jacobi_sweep`: same operand order, same divide."""
+    lz, ly, lx = lo
+    sz, sy, sx = size
+    slab = lax.dynamic_slice(
+        src3, (lz - 1, ly - 1, lx - 1), (sz + 2, sy + 2, sx + 2)
+    )
+    avg = (
+        slab[1 : sz + 1, 1 : sy + 1, 0:sx]
+        + slab[1 : sz + 1, 1 : sy + 1, 2 : sx + 2]
+        + slab[1 : sz + 1, 0:sy, 1 : sx + 1]
+        + slab[1 : sz + 1, 2 : sy + 2, 1 : sx + 1]
+        + slab[0:sz, 1 : sy + 1, 1 : sx + 1]
+        + slab[2 : sz + 2, 1 : sy + 1, 1 : sx + 1]
+    ) / 6
+    selc = lax.dynamic_slice(sel3, lo, size)
+    avg = jnp.where(selc == 1, HOT_TEMP, jnp.where(selc == 2, COLD_TEMP, avg))
+    return lax.dynamic_update_slice(o3, avg.astype(o3.dtype), lo)
+
+
+def _patch_shells_dyn(spec, src, out, sel, multi_block_only: bool):
+    """Patch every boundary shell of an uneven-partition block from the
+    exchanged state (the dynamic-extent exterior pass; see ops/shells.py)."""
+    from .shells import dyn_block_sizes, include_axes, shell_regions
+
+    p = spec.padded()
+    shp = out.shape
+    s3 = src.reshape(p.z, p.y, p.x)
+    o3 = out.reshape(p.z, p.y, p.x)
+    sel3 = sel.reshape(p.z, p.y, p.x)
+    sizes = dyn_block_sizes(spec)
+    for lo, size in shell_regions(spec, sizes, include_axes(spec, multi_block_only)):
+        o3 = _sweep_slab_dyn(s3, o3, sel3, lo, size)
+    return o3.reshape(shp)
+
+
 def jacobi6_block(block, radius: Radius, masks=None):
     """One full-compute-region Jacobi sweep over a padded block, in place of
     the halo ring (reference kernel over the whole region,
@@ -87,8 +127,10 @@ def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None,
     ``overlap=True`` replicates the reference's interior/exterior split
     (bin/jacobi3d.cu:296-368): the interior sweep reads pre-exchange data
     (it never touches halos, src/stencil.cu:878-921), the ≤6 exterior slabs
-    read exchanged halos. On an uneven partition the step falls back to
-    exchange-then-full-sweep (slab extents would be data-dependent).
+    read exchanged halos. On an uneven partition the exterior slabs become
+    dynamic-offset shells (ops/shells.py) — per-block extents are static per
+    block index, so the overlap structure survives uneven splits exactly as
+    the reference's per-LocalDomain regions do (src/stencil.cu:878-977).
     """
     return _compile_jacobi(ex, overlap, iters=None, use_pallas=use_pallas,
                            standard_spheres=standard_spheres, interpret=interpret)
@@ -118,7 +160,10 @@ def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
     if use_pallas is not None:
         return bool(use_pallas)
     devs = ex.mesh.devices.flatten()
-    return ex.spec.aligned and all(d.platform == "tpu" for d in devs)
+    # resident (oversubscribed) blocks carry a stacked leading dim the
+    # fused kernels don't handle — XLA path there
+    return (ex.spec.aligned and ex.resident_z == 1
+            and all(d.platform == "tpu" for d in devs))
 
 
 def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
@@ -133,6 +178,9 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     interior = interior_region(compute, r)
     exteriors = exterior_regions(compute, interior)
     use_overlap = overlap and spec.is_uniform()
+    # uneven partitions overlap too — via dynamic-offset shells instead of
+    # static exterior rects (per-block extents are static per block index)
+    use_dyn_overlap = overlap and not spec.is_uniform()
 
     pallas_sweep = None
     pallas_axes = None
@@ -216,6 +264,15 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
                 for rect in pallas_shells:
                     out = jacobi_sweep(cur2, out, rect, masks)
                 return out, cur2
+            if use_dyn_overlap:
+                # same structure, uneven partition: the kernel still wraps
+                # self-wrap axes internally, so only multi-block-axis shells
+                # need patching — at dynamic offsets (hi side of an uneven
+                # axis sits at off + this_block_size - r)
+                out = sweep3(curr, nxt)
+                cur2 = ex.exchange_block(curr)
+                out = _patch_shells_dyn(spec, cur2, out, sel, multi_block_only=True)
+                return out, cur2
             cur2 = ex.exchange_block(curr, axes=pallas_axes)
             return sweep3(cur2, nxt), cur2
         masks = (sel == 1, sel == 2)
@@ -224,27 +281,60 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
             cur2 = ex.exchange_block(curr)
             for rect in exteriors:
                 out = jacobi_sweep(cur2, out, rect, masks)
+        elif use_dyn_overlap:
+            # uneven: full-region sweep on PRE-exchange data (cells within r
+            # of a boundary read stale halos and are re-swept below; jacobi
+            # never reads the out buffer, so the over-write is harmless),
+            # exchange concurrent by dataflow, then dynamic-offset shells on
+            # every side (self-wrap halos are stale pre-exchange too)
+            out = jacobi_sweep(curr, nxt, compute, masks)
+            cur2 = ex.exchange_block(curr)
+            out = _patch_shells_dyn(spec, cur2, out, sel, multi_block_only=False)
         else:
             cur2 = ex.exchange_block(curr)
             out = jacobi_sweep(cur2, nxt, compute, masks)
         # swap: computed buffer becomes curr, old curr becomes scratch
         return out, cur2
 
-    # temporal blocking: when every axis self-wraps (single block) and the
-    # loop is fused, advance k steps per HBM pass — the stencil is purely
-    # memory-bound, so HBM traffic drops ~1/k. Measured at 512^3 on v5e:
-    # k=2 5.69 ms/step, k=6 3.88, k=10 3.20 (the k->inf floor is the
-    # in-VMEM wavefront cost, ~3 ms), so depth is capped at 10 and further
-    # bounded by the z extent (pipeline needs nz >= 2k+1) and by the
-    # staging planes fitting the VMEM budget ((k-1)*3 + 6 full planes).
+    # temporal blocking: advance k steps per HBM pass when the loop is
+    # fused — the stencil is purely memory-bound, so HBM traffic drops
+    # ~1/k. Measured at 512^3 on v5e: k=2 5.69 ms/step, k=6 3.88, k=10
+    # 3.20 (the k->inf floor is the in-VMEM wavefront cost, ~3 ms), so
+    # depth is capped at 10 and further bounded by the z extent (pipeline
+    # needs nz >= 2k+1) and by the staging planes fitting the VMEM budget
+    # ((k-1)*3 + 6 full planes). On a single block every axis self-wraps
+    # in-kernel; on a uniform multi-block mesh the same kernel runs in
+    # deep-halo mode — one radius-k exchange per k steps (the
+    # communication-avoiding scheme; k is then also bounded by the
+    # realized multi-block-axis radii, so drivers opt in by realizing
+    # with radius k).
     multistep = None
+    deep_halo = False
     TEMPORAL_K = 0
-    if pallas_sweep is not None and pallas_axes == () and standard_spheres and iters:
+    if (pallas_sweep is not None and pallas_axes is not None
+            and standard_spheres and iters and spec.is_uniform()):
         p = spec.padded()
         plane = p.y * p.x * 4
         budget = 46 * 1024 * 1024  # measured compile ceiling minus headroom
         k_mem = (budget // plane - 6) // 3 + 1
-        TEMPORAL_K = max(0, min(10, (spec.base.z - 1) // 2, iters, k_mem))
+        k_cap = max(0, min(10, (spec.base.z - 1) // 2, iters, k_mem))
+        if pallas_axes:
+            # multi-block: the fused multistep subsumes the overlap
+            # structure, so it only engages when overlap was requested —
+            # overlap=False must keep timing the serialized reference
+            # structure (the A/B knob the benchmarks rely on)
+            r_mb = [
+                rr for m, rl, rh in (
+                    (spec.dim.z > 1, r.z(-1), r.z(1)),
+                    (spec.dim.y > 1, r.y(-1), r.y(1)),
+                    (spec.dim.x > 1, r.x(-1), r.x(1)),
+                ) if m for rr in (rl, rh)
+            ]
+            k_cap = min(k_cap, *r_mb)
+            deep_halo = overlap and k_cap >= 2
+            TEMPORAL_K = k_cap if deep_halo else 0
+        else:
+            TEMPORAL_K = k_cap
     if TEMPORAL_K >= 2:
         from .pallas_stencil import make_pallas_jacobi_multistep
         from ..parallel.mesh import MESH_AXES
@@ -257,12 +347,33 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     def entry_fn(curr, nxt, sel):
         if multistep is not None:
             p = spec.padded()
+            if deep_halo:
+                from ..parallel.mesh import AXIS_X, AXIS_Y, AXIS_Z
+
+                idx = [
+                    lax.axis_index(n) if d > 1 else 0
+                    for n, d in ((AXIS_Z, spec.dim.z), (AXIS_Y, spec.dim.y),
+                                 (AXIS_X, spec.dim.x))
+                ]
+                org = jnp.stack([
+                    jnp.asarray(idx[0] * spec.base.z, jnp.int32),
+                    jnp.asarray(idx[1] * spec.base.y, jnp.int32),
+                    jnp.asarray(idx[2] * spec.base.x, jnp.int32),
+                ])
 
             def mbody(cn):
                 c, x = cn
-                out = multistep(
-                    c.reshape(p.z, p.y, p.x), x.reshape(p.z, p.y, p.x)
-                ).reshape(c.shape)
+                if deep_halo:
+                    # one radius-k exchange feeds k fused steps; self-wrap
+                    # axes are still wrapped inside the kernel
+                    c = ex.exchange_block(c, axes=pallas_axes)
+                    out = multistep(
+                        org, c.reshape(p.z, p.y, p.x), x.reshape(p.z, p.y, p.x)
+                    ).reshape(c.shape)
+                else:
+                    out = multistep(
+                        c.reshape(p.z, p.y, p.x), x.reshape(p.z, p.y, p.x)
+                    ).reshape(c.shape)
                 return (out, c)
 
             n_multi, n_single = divmod(iters, TEMPORAL_K)

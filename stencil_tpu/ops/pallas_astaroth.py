@@ -152,6 +152,7 @@ def make_pallas_substep(
     interpret: bool = False,
     vma=None,
     tiles: Tuple[int, int] = None,
+    _skip_shift: bool = False,  # timing probe only: wrong results
 ):
     """Build ``fn(curr8, out8) -> out8`` over padded (pz, py, px) fp32
     blocks: one RK3 stage for all fields, out buffers updated in place.
@@ -270,7 +271,8 @@ def make_pallas_substep(
                 # shift the window down by tz planes, then append the fresh
                 # planes (the RHS loads fully before the store, so the
                 # overlapping ranges are safe)
-                win[f, 0 : 2 * H] = win[f, tz : tz + 2 * H]
+                if not _skip_shift:
+                    win[f, 0 : 2 * H] = win[f, tz : tz + 2 * H]
                 win[f, 2 * H : 2 * H + tz] = stage[zi % 2, f]
 
         if substep:

@@ -324,17 +324,32 @@ def make_pallas_jacobi_multistep(
     interpret: bool = False,
     vma=None,
 ):
-    """Temporal-blocked Jacobi: ``fn(curr, nxt) -> new_next`` advances the
-    field ``k`` steps in ONE pass over HBM.
+    """Temporal-blocked Jacobi: advance the field ``k`` steps in ONE pass
+    over HBM.
 
-    Single-block (all axes self-wrap) only. A z-wavefront streams planes
-    through VMEM: when input plane j arrives, stage 1 computes plane j-1,
-    stage 2 plane j-2, ..., stage k (the output) plane j-k. Periodic z is
-    handled by wrapped plane indices on the input fetch; y/x wrap by
-    in-VMEM row/column copies on every stage plane. HBM traffic per step
-    drops from (1 read + 1 write) to ((1 + eps) read + 1 write) / k — the
-    communication-avoiding scheme that matters on a machine where the
-    stencil is purely memory-bound.
+    A z-wavefront streams planes through VMEM: when input plane j arrives,
+    stage 1 computes plane j-1, stage 2 plane j-2, ..., stage k (the
+    output) plane j-k. HBM traffic per step drops from (1 read + 1 write)
+    to ((1 + eps) read + 1 write) / k — the communication-avoiding scheme
+    that matters on a machine where the stencil is purely memory-bound.
+
+    Axis handling is derived per axis from ``spec.dim``:
+
+    - single-block axes are periodic onto themselves: wrapped plane indices
+      on the input fetch (z), in-VMEM ring copies on every stage plane
+      (y/x) — no exchange at all, the original single-block behavior;
+    - multi-block axes use **deep halos**: the caller exchanges radius-k
+      halos ONCE, then stage s computes extents extended (k - s) cells
+      into the halo ring, shrinking to the owned region at stage k. One
+      exchange per k steps — temporal blocking that survives weak scaling
+      (the deep-halo composition of the reference's wrap math,
+      dim3.hpp:208-230, with its exchange loop, bin/jacobi3d.cu:296-368).
+
+    Multi-block (uniform partitions only) requires radius >= k on both
+    sides of every multi-block axis; the returned ``fn(org, curr, nxt)``
+    then takes a (3,) int32 of this block's global (z, y, x) origin
+    (scalar prefetch) so the sphere fix-up stays coordinate-exact.
+    Single-block keeps the legacy ``fn(curr, nxt)`` signature.
 
     The hot/cold sphere fix-up is computed inline from integer coordinates:
     the reference's ``int64(sqrtf(d2)) <= R`` (bin/jacobi3d.cu:30-32,49) is
@@ -342,13 +357,23 @@ def make_pallas_jacobi_multistep(
     integer < 2^24 cannot cross an integer boundary), so no sel array is
     read at all.
     """
-    assert spec.dim == Dim3(1, 1, 1), "multistep requires a single block"
     assert spec.aligned
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     off = spec.compute_offset()
     zo, yo, xo = off.z, off.y, off.x
     nz, ny, nx = spec.base.z, spec.base.y, spec.base.x
+    mz, my, mx = spec.dim.z > 1, spec.dim.y > 1, spec.dim.x > 1
+    use_org = mz or my or mx
+    r = spec.radius
+    if use_org:
+        assert spec.is_uniform(), "deep-halo multistep requires a uniform partition"
+        for m, rl, rh in (
+            (mz, r.z(-1), r.z(1)), (my, r.y(-1), r.y(1)), (mx, r.x(-1), r.x(1))
+        ):
+            assert not m or (rl >= k and rh >= k), (
+                "deep-halo multistep needs radius >= k on multi-block axes"
+            )
     assert nz >= 2 * k + 1, "domain too shallow for this temporal depth"
     J = nz + 2 * k  # pipeline steps: input vplanes -k .. nz+k-1
     g = spec.global_size
@@ -358,7 +383,20 @@ def make_pallas_jacobi_multistep(
     xs = slice(xo, xo + nx)
     N_IN = 4  # input ring: 3 live planes + 1 in flight
 
-    def kernel(curr_hbm, nxt_hbm, out_hbm, in_v, st_v, out_v, s_in, s_out):
+    def ext(s):
+        """(ey, ex) compute-extent extension of stage s into the halo ring
+        (stage 0 = the exchanged deep-halo input)."""
+        return ((k - s) if my else 0, (k - s) if mx else 0)
+
+    def kernel(*refs):
+        if use_org:
+            org, curr_hbm, nxt_hbm, out_hbm, in_v, st_v, out_v, s_in, s_out = refs
+            ozv = org[0] if mz else 0
+            oyv = org[1] if my else 0
+            oxv = org[2] if mx else 0
+        else:
+            curr_hbm, nxt_hbm, out_hbm, in_v, st_v, out_v, s_in, s_out = refs
+            ozv = oyv = oxv = 0
         j = pl.program_id(0)
 
         def out_dma(step):
@@ -370,7 +408,10 @@ def make_pallas_jacobi_multistep(
             )
 
         def in_dma(step):
-            ph = zo + jnp.mod(step - k, nz)  # wrapped physical input plane
+            if mz:
+                ph = zo - k + step  # deep-halo plane, no wrap
+            else:
+                ph = zo + jnp.mod(step - k, nz)  # wrapped physical plane
             return pltpu.make_async_copy(
                 curr_hbm.at[pl.ds(ph, 1)],
                 in_v.at[pl.ds(jnp.mod(step, N_IN), 1)],
@@ -387,19 +428,28 @@ def make_pallas_jacobi_multistep(
 
         in_dma(j).wait()
 
-        def fill_wrap(ref, slot):
-            # periodic y/x halo ring, filled from the opposite compute face
-            ref[slot, yo - 1, xs] = ref[slot, yo + ny - 1, xs]
-            ref[slot, yo + ny, xs] = ref[slot, yo, xs]
-            ref[slot, yo - 1 : yo + ny + 1, xo - 1] = ref[slot, yo - 1 : yo + ny + 1, xo + nx - 1]
-            ref[slot, yo - 1 : yo + ny + 1, xo + nx] = ref[slot, yo - 1 : yo + ny + 1, xo]
+        def fill_wrap(ref, slot, ey, ex):
+            """Periodic rings of the self-wrap axes on a plane whose valid
+            extents are extended (ey, ex) into the halo (multi-block axes);
+            the ring spans the full valid extent so the next stage's
+            shifted reads stay within filled cells."""
+            xw = slice(xo - ex, xo + nx + ex)
+            if not my:
+                ref[slot, yo - 1, xw] = ref[slot, yo + ny - 1, xw]
+                ref[slot, yo + ny, xw] = ref[slot, yo, xw]
+            if not mx:
+                ry = 0 if my else 1
+                yw = slice(yo - ey - ry, yo + ny + ey + ry)
+                ref[slot, yw, xo - 1] = ref[slot, yw, xo + nx - 1]
+                ref[slot, yw, xo + nx] = ref[slot, yw, xo]
 
-        fill_wrap(in_v, jnp.mod(j, N_IN))
+        fill_wrap(in_v, jnp.mod(j, N_IN), *ext(0))
 
         for s in range(1, k + 1):
             @pl.when(j >= 2 * s)
             def _(s=s):
                 v = j - k - s  # this stage's output vplane
+                ey, ex = ext(s)
 
                 def prev_plane(u):
                     """(ref, slot) holding stage s-1 (or input) vplane u."""
@@ -413,14 +463,15 @@ def make_pallas_jacobi_multistep(
                         return ref[slot, ys, xsl]
                     return ref[s - 2, slot, ys, xsl]
 
-                cy = slice(yo, yo + ny)
+                cy = slice(yo - ey, yo + ny + ey)
+                cx = slice(xo - ex, xo + nx + ex)
                 avg = (
-                    rd(v, cy, slice(xo - 1, xo + nx - 1))
-                    + rd(v, cy, slice(xo + 1, xo + nx + 1))
-                    + rd(v, slice(yo - 1, yo + ny - 1), xs)
-                    + rd(v, slice(yo + 1, yo + ny + 1), xs)
-                    + rd(v - 1, cy, xs)
-                    + rd(v + 1, cy, xs)
+                    rd(v, cy, slice(xo - ex - 1, xo + nx + ex - 1))
+                    + rd(v, cy, slice(xo - ex + 1, xo + nx + ex + 1))
+                    + rd(v, slice(yo - ey - 1, yo + ny + ey - 1), cx)
+                    + rd(v, slice(yo - ey + 1, yo + ny + ey + 1), cx)
+                    + rd(v - 1, cy, cx)
+                    + rd(v + 1, cy, cx)
                 ) / 6.0  # divide: bit-parity with ops.jacobi.jacobi_sweep
                 if s == k:
                     # the same out slot was last used at step j-2; drain it
@@ -432,17 +483,26 @@ def make_pallas_jacobi_multistep(
                     if s == k:
                         out_v[jnp.mod(j, 2), yo:yo + ny, xs] = plane
                     else:
-                        st_v[s - 1, jnp.mod(v, 3), yo:yo + ny, xs] = plane
+                        st_v[s - 1, jnp.mod(v, 3), cy, cx] = plane
 
                 # sphere fix-up only on planes intersecting the spheres
-                # (both share the same z center and radius)
-                zg = jnp.mod(v, nz)
+                # (both share the same z center and radius). Halo-extended
+                # cells of a multi-block axis can sit beyond the global
+                # extent (v < 0 / index >= g); their true coordinate is the
+                # periodic wrap — without it a boundary-crossing sphere
+                # would clamp differently here than on the owning block.
+                zg = jnp.mod(ozv + v, g.z) if mz else jnp.mod(v, nz)
                 near = jnp.abs(zg - hot_c[2]) <= g.x // 10
 
                 @pl.when(near)
                 def _():
-                    row = jax.lax.broadcasted_iota(jnp.int32, (ny, nx), 0)
-                    col = jax.lax.broadcasted_iota(jnp.int32, (ny, nx), 1)
+                    shape = (ny + 2 * ey, nx + 2 * ex)
+                    row = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + (oyv - ey)
+                    col = jax.lax.broadcasted_iota(jnp.int32, shape, 1) + (oxv - ex)
+                    if my:
+                        row = jnp.mod(row, g.y)
+                    if mx:
+                        col = jnp.mod(col, g.x)
                     dz2 = (zg - hot_c[2]) ** 2
                     hot = (row - hot_c[1]) ** 2 + (col - hot_c[0]) ** 2 + dz2 < thresh
                     cold = jnp.logical_and(
@@ -456,7 +516,7 @@ def make_pallas_jacobi_multistep(
                     write(avg)
 
                 if s < k:
-                    fill_wrap(st_v.at[s - 1], jnp.mod(v, 3))
+                    fill_wrap(st_v.at[s - 1], jnp.mod(v, 3), ey, ex)
 
         @pl.when(j >= 2 * k)
         def _():
@@ -471,7 +531,37 @@ def make_pallas_jacobi_multistep(
         out_shape = jax.ShapeDtypeStruct((pz, py, px), jnp.float32)
     else:
         out_shape = jax.ShapeDtypeStruct((pz, py, px), jnp.float32, vma=frozenset(vma))
-    fn = pl.pallas_call(
+    scratch = [
+        pltpu.VMEM((N_IN, py, px), jnp.float32),
+        pltpu.VMEM((max(k - 1, 1), 3, py, px), jnp.float32),
+        pltpu.VMEM((2, py, px), jnp.float32),
+        pltpu.SemaphoreType.DMA((N_IN,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    params = pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",),
+        has_side_effects=True,
+        vmem_limit_bytes=100 * 1024 * 1024,
+    )
+    if use_org:
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(J,),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=scratch,
+            ),
+            out_shape=out_shape,
+            input_output_aliases={2: 0},  # (org, curr, nxt) -> nxt
+            compiler_params=params,
+            interpret=interpret,
+        )
+    return pl.pallas_call(
         kernel,
         grid=(J,),
         out_shape=out_shape,
@@ -480,22 +570,11 @@ def make_pallas_jacobi_multistep(
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[
-            pltpu.VMEM((N_IN, py, px), jnp.float32),
-            pltpu.VMEM((max(k - 1, 1), 3, py, px), jnp.float32),
-            pltpu.VMEM((2, py, px), jnp.float32),
-            pltpu.SemaphoreType.DMA((N_IN,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch,
         input_output_aliases={1: 0},
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-            has_side_effects=True,
-            vmem_limit_bytes=100 * 1024 * 1024,
-        ),
+        compiler_params=params,
         interpret=interpret,
     )
-    return fn
 
 
 def sel_z_range(spec: GridSpec) -> Tuple[int, int]:

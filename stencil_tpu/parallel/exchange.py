@@ -104,8 +104,24 @@ class HaloExchange:
     """
 
     def __init__(self, spec: GridSpec, mesh: Mesh, method: Method = Method.AXIS_COMPOSED):
-        if mesh_dim(mesh) != spec.dim:
-            raise ValueError(f"mesh {dict(mesh.shape)} does not match partition {spec.dim}")
+        md = mesh_dim(mesh)
+        # oversubscription (reference: dd.set_gpus({0,0}), stencil.hpp:154,
+        # test_exchange.cu:52): more partition blocks than devices — the
+        # extra blocks are RESIDENT: stacked along the block dims of each
+        # shard, exchanged by intra-device slab shifts (see
+        # _axis_phase_resident). Supported on the z axis, uniform splits.
+        if (md.x, md.y) != (spec.dim.x, spec.dim.y) or spec.dim.z % md.z:
+            raise ValueError(
+                f"mesh {dict(mesh.shape)} does not match partition {spec.dim}"
+            )
+        self.resident_z = spec.dim.z // md.z
+        if self.resident_z > 1:
+            if len(set(spec.sizes_z)) != 1:
+                raise ValueError(
+                    "oversubscription (blocks > devices) requires a uniform z split"
+                )
+            if method == Method.DIRECT26:
+                raise ValueError("Method.DIRECT26 does not support oversubscription")
         if method == Method.DIRECT26 and not spec.is_uniform():
             raise ValueError("Method.DIRECT26 requires a uniform partition")
         for name in (AXIS_X, AXIS_Y, AXIS_Z):
@@ -265,6 +281,10 @@ class HaloExchange:
         devs = self.mesh.devices.flatten()
         if not all(d.platform == "tpu" for d in devs):
             return {}
+        if self.resident_z > 1:
+            # resident shards carry a (c,1,1) leading block shape the fill
+            # kernels' single-block reshape can't represent — XLA slab path
+            return {}
         from ..ops.halo_fill import make_self_fill, self_fill_supported
         from .mesh import MESH_AXES
 
@@ -280,6 +300,8 @@ class HaloExchange:
         sizes, rm, rp, off = _spec_axis(spec, name)
         if rm == 0 and rp == 0:
             return block
+        if name == AXIS_Z and self.resident_z > 1:
+            return self._axis_phase_resident(block, name, adim, self.resident_z)
         if (
             len(sizes) == 1
             and block.dtype == jnp.float32
@@ -311,6 +333,49 @@ class HaloExchange:
             if n > 1:
                 slab = lax.ppermute(slab, name, bwd)
             block = _update_in_dim(block, slab, off + sz, adim)
+        return block
+
+    def _axis_phase_resident(self, block, name: str, adim: int, c: int):
+        """Axis phase with ``c`` partition blocks resident per device along
+        this axis (oversubscription). Neighbor slabs between resident
+        blocks shift along the stacked block dim — a pure local copy, the
+        analogue of the reference's same-GPU ``PeerAccessSender``
+        short-circuit (tx_cuda.cuh:41-113) — and only the two boundary
+        slabs ride the collective permute."""
+        spec = self.spec
+        sizes, rm, rp, off = _spec_axis(spec, name)
+        sz = sizes[0]  # uniform (validated in __init__)
+        bdim = {AXIS_Z: 0, AXIS_Y: 1, AXIS_X: 2}[name]
+        n_dev = len(sizes) // c
+        fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        bwd = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+
+        def take(start, width):
+            s = [slice(None)] * block.ndim
+            s[adim] = slice(start, start + width)
+            return block[tuple(s)]
+
+        if rm > 0:
+            # resident r's top rm planes -> resident r+1's low halo; the
+            # last resident's slab rides the permute to the next device's
+            # resident 0 (fwd: device d receives from d-1)
+            sl = take(off + sz - rm, rm)
+            last = lax.slice_in_dim(sl, c - 1, c, axis=bdim)
+            if n_dev > 1:
+                last = lax.ppermute(last, name, fwd)
+            shifted = jnp.concatenate(
+                [last, lax.slice_in_dim(sl, 0, c - 1, axis=bdim)], axis=bdim
+            )
+            block = _update_in_dim(block, shifted, off - rm, adim)
+        if rp > 0:
+            sl = take(off, rp)
+            first = lax.slice_in_dim(sl, 0, 1, axis=bdim)
+            if n_dev > 1:
+                first = lax.ppermute(first, name, bwd)
+            shifted = jnp.concatenate(
+                [lax.slice_in_dim(sl, 1, c, axis=bdim), first], axis=bdim
+            )
+            block = _update_in_dim(block, shifted, off + sz, adim)
         return block
 
     # -- direct-26 implementation -------------------------------------------

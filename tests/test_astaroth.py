@@ -245,6 +245,9 @@ def global_reference_iteration(fields, out, info, dt):
         # genuinely uneven 2x2x2 split (x blocks 10 and 9) — exercises the
         # remainder-partition exchange under the full workload
         (False, (19, 18, 14)),
+        # uneven + overlap: masked interior write + dynamic-offset shells
+        # (ops/shells.py, VERDICT r2 item 8)
+        (True, (19, 18, 14)),
     ],
 )
 def test_distributed_step_matches_global_reference(overlap, size):
@@ -469,3 +472,83 @@ def test_distributed_pallas_overlap_mixed_mesh_matches_xla():
         np.testing.assert_allclose(
             outs["pallas"][k], outs["xla"][k], rtol=1e-5, atol=1e-7, err_msg=k
         )
+
+
+def test_distributed_pallas_overlap_uneven_matches_xla():
+    """Fused-Pallas overlap on a genuinely uneven 2x2x2 split (x blocks 10
+    and 9; interpret mode): substep 0's full kernel pass from pre-exchange
+    data, then dynamic-offset shells on every side — must match the
+    serialized fp32 XLA path (VERDICT r2 item 8)."""
+    nx, ny, nz = 19, 16, 14
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = nx
+    info.int_params["AC_ny"] = ny
+    info.int_params["AC_nz"] = nz
+    info.update_builtin_params()
+    dt = 1e-3
+    size = Dim3(nx, ny, nz)
+    rng = np.random.RandomState(7)
+    fields = {
+        k: (rng.randn(nz, ny, nx) * 0.05).astype(np.float32) for k in FIELDS
+    }
+    fields["lnrho"] = fields["lnrho"] + np.float32(0.5)
+
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(3))
+    assert not spec.is_uniform()
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    ex = HaloExchange(spec, mesh)
+
+    outs = {}
+    for label, kwargs in (
+        ("pallas-overlap", dict(use_pallas=True, interpret=True, overlap=True)),
+        ("xla-serial", dict(use_pallas=False, overlap=False)),
+    ):
+        step = make_astaroth_step(ex, info, dt=dt, dtype="float32", **kwargs)
+        curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
+        nxt = {
+            k: shard_blocks(np.zeros((nz, ny, nx), np.float32), spec, mesh)
+            for k in FIELDS
+        }
+        for _ in range(2):
+            curr, nxt = step(curr, nxt)
+        outs[label] = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
+
+    for k in FIELDS:
+        np.testing.assert_allclose(
+            outs["pallas-overlap"][k], outs["xla-serial"][k],
+            rtol=1e-5, atol=1e-7, err_msg=k,
+        )
+
+
+def test_oversubscribed_distributed_step_matches_reference():
+    """2x2x2 split on 4 devices (2 z-blocks resident per device): the full
+    RK3 iteration must match the np.roll global reference."""
+    n = 16
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    dt = 1e-3
+    size = Dim3(n, n, n)
+    rng = np.random.RandomState(1)
+    fields = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
+    fields["lnrho"] = fields["lnrho"] + 0.5
+
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(3))
+    mesh = grid_mesh(Dim3(2, 2, 1), jax.devices()[:4])
+    ex = HaloExchange(spec, mesh)
+    assert ex.resident_z == 2
+    step = make_astaroth_step(ex, info, dt=dt, overlap=True)
+    curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
+    nxt = {k: shard_blocks(np.zeros((n, n, n)), spec, mesh) for k in FIELDS}
+    curr, nxt = step(curr, nxt)
+    got = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
+
+    ref_out = {k: np.zeros((n, n, n)) for k in FIELDS}
+    ref_curr, _ = global_reference_iteration(dict(fields), ref_out, info, dt)
+    for k in FIELDS:
+        np.testing.assert_allclose(got[k], ref_curr[k], rtol=1e-10, atol=1e-12,
+                                   err_msg=k)

@@ -155,3 +155,27 @@ def test_pingpong_rows():
     assert len(rows) >= 2
     for r in rows:
         assert r["latency_us"] > 0 and r["gb_per_s"] > 0
+
+
+def test_weak_scaling_harness_smoke():
+    from stencil_tpu.apps import weak_scaling
+
+    res = weak_scaling.run(
+        devices=jax.devices()[:8],
+        iters=2, jacobi_iters=2, overlap_rounds=1,
+        per_chip=weak_scaling.Dim3(16, 16, 16),
+        exw_per_chip=weak_scaling.Dim3(16, 16, 16),
+        config2_global=weak_scaling.Dim3(16, 16, 16),
+    )
+    lines = weak_scaling.csv_rows(res)
+    assert lines[0] == weak_scaling.CSV_HEADER
+    assert len(lines) == 5
+    names = [l.split(",")[0] for l in lines[1:]]
+    assert names == [
+        "config2_exchange", "config3_exchange_weak",
+        "config5_jacobi_overlap", "config5_hidden_frac",
+    ]
+    for line in lines[1:]:
+        parts = line.split(",")
+        assert int(parts[4]) == 8
+        assert float(parts[5]) > 0  # seconds

@@ -157,3 +157,65 @@ def test_bytes_accounting():
     # per block: 6 faces 4*4*1 + 12 edges 4*1*1 + 8 corners 1 = 16*6+4*12+8 = 152
     assert ex.bytes_logical([4]) == 8 * (6 * 16 + 12 * 4 + 8) * 4
     assert ex.bytes_moved([4]) >= ex.bytes_logical([4])
+
+
+def test_oversubscribed_exchange_halo_parity():
+    """8 blocks on 4 devices (2 z-blocks resident per device, reference:
+    dd.set_gpus({0,0}), test_exchange.cu:52): every halo cell must carry
+    its periodically wrapped source coordinate, and the result must equal
+    the same partition realized on 8 devices."""
+    import jax
+
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks
+
+    size = Dim3(12, 12, 12)
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(2))
+    coord = (
+        np.arange(size.z)[:, None, None] * 1_000_000
+        + np.arange(size.y)[None, :, None] * 1_000
+        + np.arange(size.x)[None, None, :]
+    ).astype(np.float32)
+
+    results = {}
+    for label, mesh_dim, ndev in (("over", Dim3(2, 2, 1), 4),
+                                  ("full", Dim3(2, 2, 2), 8)):
+        mesh = grid_mesh(mesh_dim, jax.devices()[:ndev])
+        ex = HaloExchange(spec, mesh)
+        assert ex.resident_z == (2 if label == "over" else 1)
+        state = ex({0: shard_blocks(coord, spec, mesh)})
+        results[label] = np.asarray(jax.device_get(state[0]))
+    np.testing.assert_array_equal(results["over"], results["full"])
+
+    # independent halo check on the oversubscribed result, every block
+    arr = results["over"]
+    off = spec.compute_offset()
+    r = spec.radius
+    for bz in range(2):
+        for by in range(2):
+            for bx in range(2):
+                blk = arr[bz, by, bx]
+                org = spec.block_origin((bx, by, bz))
+                bs = spec.block_size((bx, by, bz))
+                for z in range(off.z - r.z(-1), off.z + bs.z + r.z(1)):
+                    gz = (org.z + z - off.z) % size.z
+                    for (y, x) in ((off.y - 1, off.x), (off.y + bs.y, off.x + bs.x - 1)):
+                        gy = (org.y + y - off.y) % size.y
+                        gx = (org.x + x - off.x) % size.x
+                        want = gz * 1_000_000 + gy * 1_000 + gx
+                        assert blk[z, y, x] == want, (bz, by, bx, z, y, x)
+
+
+def test_oversubscribed_rejects_uneven_z():
+    import jax
+
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+
+    spec = GridSpec(Dim3(12, 12, 13), Dim3(2, 2, 2), Radius.constant(1))
+    mesh = grid_mesh(Dim3(2, 2, 1), jax.devices()[:4])
+    with pytest.raises(ValueError, match="uniform z split"):
+        HaloExchange(spec, mesh)
