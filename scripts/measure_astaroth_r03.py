@@ -1,0 +1,14 @@
+"""Round-3 astaroth numbers for BASELINE.md: 256^3 and 512^3 iteration
+times with the sliding-window substep kernel (fused chunks)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+from stencil_tpu.apps.astaroth import run as asta_run
+
+for nx, iters, chunk in ((256, 60, 30), (512, 12, 6)):
+    r = asta_run(iters=iters, devices=jax.devices()[:1], dtype="float32",
+                 nx=nx, chunk=chunk)
+    ms = r["iter_trimean_s"] * 1e3
+    mc = nx ** 3 / r["iter_trimean_s"] / 1e6
+    print(f"astaroth {nx}^3 fp32: {ms:.1f} ms/iter trimean "
+          f"({mc:.0f} Mcells/s), iters_run={r['iters_run']}", flush=True)

@@ -1,0 +1,38 @@
+"""Pure substep timing under the tight-x layout (no exchange in the loop):
+the round-3 per-substep number for BASELINE.md."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from stencil_tpu.astaroth.config import load_config
+from stencil_tpu.astaroth.equations import Constants
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.ops.pallas_astaroth import FIELDS, make_pallas_substep, pick_tiles
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+info, _ = load_config("stencil_tpu/astaroth/astaroth.conf")
+c = Constants.from_info(info)
+inv_ds = tuple(info.real_params[k] for k in ("AC_inv_dsx", "AC_inv_dsy", "AC_inv_dsz"))
+chunk = 60 if n <= 256 else 12
+for label, radius in (("tight-x", Radius.constant(3).without_x()),
+                      ("inline-x", Radius.constant(3))):
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), radius)
+    p = spec.padded()
+    rng = np.random.RandomState(7)
+    curr = tuple(jnp.asarray(rng.rand(p.z, p.y, p.x) * 0.1, jnp.float32)
+                 for _ in FIELDS)
+    out = tuple(jnp.asarray(rng.rand(p.z, p.y, p.x) * 0.1, jnp.float32)
+                for _ in FIELDS)
+    sub = make_pallas_substep(spec, c, inv_ds, 1, 1e-8)
+    fn = jax.jit(lambda cu, ou: jax.lax.fori_loop(
+        0, chunk, lambda _, o: sub(cu, o), ou), donate_argnums=(1,))
+    t0 = time.time(); out2 = fn(curr, out); hard_sync(out2)
+    cs = time.time() - t0
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter(); out2 = fn(curr, out2); hard_sync(out2)
+        st.insert((time.perf_counter() - t0) / chunk)
+    print(f"{label} {n}^3 tiles={pick_tiles(spec)}: "
+          f"{st.trimean()*1e3:.2f} ms/substep (compile {cs:.0f}s)", flush=True)

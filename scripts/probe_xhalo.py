@@ -102,8 +102,8 @@ def make_sweep(px, xo, tight):
         c = in_v[slot, 1:TZ + 1]
         if tight:
             mid = c[:, ctr, :]
-            xm = pltpu.roll(mid, 1, 2)   # col j reads j-1 (wraps)
-            xp = pltpu.roll(mid, -1, 2)  # col j reads j+1 (wraps)
+            xm = pltpu.roll(mid, 1, 2)        # col j reads j-1 (wraps)
+            xp = pltpu.roll(mid, nx - 1, 2)   # col j reads j+1 (wraps)
             avg = (xm + xp
                    + c[:, 7:7 + TY, :] + c[:, 9:9 + TY, :]
                    + in_v[slot, 0:TZ, ctr, :] + in_v[slot, 2:TZ + 2, ctr, :]

@@ -24,13 +24,14 @@ from typing import Optional
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from ..api import DistributedDomain
 from ..astaroth.config import load_config
 from ..astaroth.init import const_init, hash_init, radial_explosion_init
 from ..astaroth.integrate import FIELDS, make_astaroth_step, uses_pallas
 from ..astaroth.reductions import Reductions
-from ..geometry import Dim3, prime_factors
+from ..geometry import Dim3, Radius, prime_factors
 from ..parallel import Method
 from ..apps._bench_common import placement_from_flags
 from ..utils import timer
@@ -109,7 +110,22 @@ def run(
     )
 
     dd = DistributedDomain(size.x, size.y, size.z)
-    dd.set_radius(3)
+    radius = Radius.constant(3)
+    if len(devices) == 1 and use_pallas is not False:
+        # tight-x layout on one chip: no x halo columns (kernel forms the
+        # periodic x pencils with lane rolls) — sheds the px/nx DMA lane
+        # padding AND the x self-fill's lane-tile RMW entirely. Engage only
+        # when the fused kernel supports the resulting layout.
+        from ..domain.grid import GridSpec
+        from ..ops.pallas_astaroth import substep_supported
+
+        tight = radius.without_x()
+        tight_spec = GridSpec(size, Dim3(1, 1, 1), tight)
+        if (np.dtype(dtype) == np.float32
+                and devices[0].platform == "tpu"
+                and substep_supported(tight_spec, jnp.float32)):
+            radius = tight
+    dd.set_radius(radius)
     dd.set_methods(method)
     dd.set_devices(devices)
     dd.set_placement(placement_from_flags(trivial, random_))

@@ -72,7 +72,19 @@ def run(
     # deep_halo > 1 realizes radius-k halos so the fused loop can take the
     # communication-avoiding multistep on multi-block meshes (one radius-k
     # exchange per k steps); the workload stays radius-1 jacobi
-    dd.set_radius(deep_halo)
+    if (n == 1 and size.x % 128 == 0
+            and (partition is None or Dim3.of(partition) == Dim3(1, 1, 1))
+            and all(d.platform == "tpu" for d in devices)):
+        # tight-x layout: a single chip wraps x in-kernel (lane rolls), so
+        # no x halo columns are allocated — every slab DMA sheds the
+        # px/nx lane padding (1.36x at 512^3, BASELINE.md round 3). A
+        # partition override (oversubscription ablation) keeps inline
+        # halos: the zero-x-radius layout requires a single block.
+        from ..geometry import Radius
+
+        dd.set_radius(Radius.constant(deep_halo).without_x())
+    else:
+        dd.set_radius(deep_halo)
     dd.set_methods(method)
     dd.set_devices(devices)
     if partition is not None:

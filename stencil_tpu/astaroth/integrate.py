@@ -196,9 +196,19 @@ def make_astaroth_step(
     kernel as the interior."""
     spec = ex.spec
     r = spec.radius
-    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
+    assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
         "astaroth needs face radius >= 3 (6th-order stencils)"
     )
+    pallas_on = uses_pallas(ex, use_pallas, dtype)
+    if min(r.x(-1), r.x(1)) < 3:
+        # zero-x-radius tight layout (Radius.without_x): no x halo columns;
+        # only the fused kernel can form the periodic x pencils (lane
+        # rolls), and only on a single block
+        assert r.x(-1) == 0 and r.x(1) == 0 and spec.dim == Dim3(1, 1, 1), (
+            "x radius must be 3+ (inline halos) or exactly 0 (tight layout, "
+            "single block)"
+        )
+        assert pallas_on, "tight-x astaroth requires the fused Pallas path"
     inv_ds = (
         info.real_params["AC_inv_dsx"],
         info.real_params["AC_inv_dsy"],
@@ -223,7 +233,7 @@ def make_astaroth_step(
         inc = (True, True, True)  # pre-exchange halos are stale on all sides
         return interior_mask(spec, sizes, inc), shell_regions(spec, sizes, inc)
 
-    if uses_pallas(ex, use_pallas, dtype):
+    if pallas_on:
         from ..ops.pallas_astaroth import make_pallas_substep
         from ..parallel.mesh import MESH_AXES
 

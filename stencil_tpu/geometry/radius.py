@@ -53,6 +53,16 @@ class Radius:
     def __hash__(self):
         return hash(tuple(sorted(self._r.items())))
 
+    def without_x(self) -> "Radius":
+        """Copy with every x-involving direction zeroed — the tight-x
+        layout: no x halo columns are allocated or exchanged, the compute
+        kernels form the periodic x neighborhood in-kernel (lane rolls).
+        Valid only for single-block x axes with lane-aligned extents."""
+        ret = Radius()
+        for d, v in self._r.items():
+            ret._r[d] = 0 if d[0] != 0 else v
+        return ret
+
     # -- bulk setters (reference: radius.hpp:46-79) -------------------------
     def set_face(self, r: int) -> None:
         for d in ((1, 0, 0), (-1, 0, 0), (0, 1, 0), (0, -1, 0), (0, 0, 1), (0, 0, -1)):

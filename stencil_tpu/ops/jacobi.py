@@ -170,9 +170,19 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
                     standard_spheres: bool = True, interpret: bool = False):
     spec = ex.spec
     r = spec.radius
-    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
+    assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
         "jacobi needs face radius >= 1 on every side"
     )
+    if min(r.x(-1), r.x(1)) < 1:
+        # zero-x-radius tight layout (Radius.without_x): no x halo columns
+        # exist; only the Pallas kernels can form the x neighborhood
+        # (lane rolls), and only on a single-block x axis
+        assert spec.dim == Dim3(1, 1, 1) and spec.base.x % 128 == 0, (
+            "zero x radius requires a single block and a lane-aligned x extent"
+        )
+        assert _want_pallas(ex, use_pallas), (
+            "zero x radius requires the Pallas fast path (in-kernel x wrap)"
+        )
     off = spec.compute_offset()
     compute = Rect3(off, off + spec.base)
     interior = interior_region(compute, r)

@@ -14,8 +14,10 @@ The round-2 version re-fetched the full (tz + 6)-plane halo slab per tile,
 a (tz+6)/tz = 4x z-read amplification at the VMEM-forced tz=2 (measured
 18.3 ms/substep at 256^3 against a ~7 ms traffic roofline). The sliding
 window reads each input plane once per strip, so z-amplification falls to
-(nz+6)/nz and the remaining input amplification is the 8-row-aligned y
-window ((ty+16)/ty) times the x lane padding (px/nx).
+(nz+6)/nz; the remaining input amplification is the 8-row-aligned y
+window ((ty+16)/ty) times the x lane padding px/nx — which the tight-x
+layout (Radius.without_x: px == nx, x pencils via lane rolls) reduces
+to 1.
 
 The math is NOT duplicated: derivative pencils come from
 ``astaroth.fd.field_data`` and the physics from ``astaroth.equations`` —
@@ -26,9 +28,9 @@ structural (pinned by tests/test_pallas_astaroth.py in interpret mode).
 Layout contract: padded fp32 blocks with TPU-aligned planes
 (GridSpec(aligned=True)), face radii >= 3, exchanged halos (including the
 xy/yz/xz edge halos the cross-derivatives read — AXIS_COMPOSED phase
-composition provides them). The kernel writes compute rows only: out's
-x-halo columns in written rows carry the curr value (refreshed by the next
-exchange before any read), y/z halo rows/planes keep their prior contents.
+composition provides them). The kernel writes compute cells only: out's
+halo columns/rows/planes keep their prior contents (refreshed by the next
+exchange before any read).
 
 Buffering discipline (the documented lag-1 rule: a DMA started at grid
 step t may write a buffer last touched by compute at step t-1, never one
@@ -82,7 +84,10 @@ def _divisors(n: int, cands) -> list:
 
 
 def scratch_bytes(spec: GridSpec, tz: int, ty: int) -> int:
-    """Explicit VMEM scratch of the sliding-window substep at (tz, ty)."""
+    """Explicit VMEM scratch of the sliding-window substep at (tz, ty):
+    all buffers carry full px-wide rows (px == nx under the tight-x
+    layout, px == round_up(nx + 6, 128) inline) — exactly the
+    ``scratch_shapes`` allocation."""
     px = spec.padded().x
     rows_in = ty + 16
     win = NF * (tz + 2 * _HALO) * rows_in * px
@@ -110,11 +115,13 @@ def pick_tiles(spec: GridSpec) -> Tuple[int, int]:
 
 
 def substep_supported(spec: GridSpec, dtype) -> bool:
-    """Whether the fused kernel handles this block layout."""
+    """Whether the fused kernel handles this block layout. The tight-x
+    layout (Radius.without_x: zero x radius, no halo columns) is supported
+    on a single-block lane-aligned x axis — x pencils become lane rolls."""
     if not spec.aligned or dtype != jnp.float32:
         return False
     r = spec.radius
-    if min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) < _HALO:
+    if min(r.y(-1), r.y(1), r.z(-1), r.z(1)) < _HALO:
         return False
     o = spec.compute_offset()
     p = spec.padded()
@@ -123,23 +130,41 @@ def substep_supported(spec: GridSpec, dtype) -> bool:
         return False
     if o.z < _HALO or o.z + b.z + _HALO > p.z:
         return False
-    if o.x < _HALO or o.x + b.x + _HALO > p.x:
+    if r.x(-1) == 0 and r.x(1) == 0:
+        if spec.dim.x != 1 or b.x % 128 or o.x != 0:
+            return False
+    elif min(r.x(-1), r.x(1)) < _HALO:
+        return False
+    elif o.x < _HALO or o.x + b.x + _HALO > p.x:
         return False
     return pick_tiles(spec) != (0, 0)
 
 
 class _SlabView:
     """Adapter letting fd.field_data slice a field's plane window of the
-    VMEM scratch ref as if it were a plain [z, y, x] array."""
+    VMEM scratch ref as if it were a plain [z, y, x] array.
 
-    __slots__ = ("ref", "pre")
+    ``wrap_nx``: tight-x layout — the window carries exactly nx columns
+    with no halos, and x-shifted pencil reads become in-VMEM lane rolls
+    (out[j] = base[(j + dx) mod nx], the periodic neighborhood)."""
 
-    def __init__(self, ref, pre):
+    __slots__ = ("ref", "pre", "wrap_nx")
+
+    def __init__(self, ref, pre, wrap_nx=None):
         self.ref = ref
         self.pre = pre
+        self.wrap_nx = wrap_nx
 
     def __getitem__(self, idx):
         assert isinstance(idx, tuple) and idx[0] is Ellipsis, idx
+        nx = self.wrap_nx
+        if nx is not None:
+            zsl, ysl, xsl = idx[1:]
+            dx = xsl.start  # tight layout: xsl == slice(dx, nx + dx)
+            assert xsl.stop - dx == nx, (xsl, nx)
+            if dx != 0:
+                base = self.ref[self.pre + (zsl, ysl, slice(0, nx))]
+                return pltpu.roll(base, (-dx) % nx, 2)
         return self.ref[self.pre + idx[1:]]
 
 
@@ -171,12 +196,19 @@ def make_pallas_substep(
     rows_in = ty + 16  # y window [y0-8, y0+ty+8): +-3 halo rows, 8-aligned
     H = _HALO
     W = tz + 2 * H  # window planes per field
+    # tight-x layout (Radius.without_x, single-block x): px == nx, off.x
+    # == 0, no x halo columns exist — slabs are full rows with zero lane
+    # padding and the periodic x pencils come from in-VMEM lane rolls
+    # (Mosaic requires DMA x-slice offsets AND widths to be 128-aligned,
+    # so slicing an inline-halo layout tighter is not expressible; the
+    # layout change is)
+    tight_x = spec.radius.x(-1) == 0 and spec.radius.x(1) == 0
     beta = RK3_BETA[substep]
     alpha_over_pb = RK3_ALPHA[substep] / RK3_BETA[substep - 1] if substep else 0.0
     ids = tuple(float(v) for v in inv_ds)
     # window-local region the rates are produced over
     rect = Rect3(Dim3(xo, 8, H), Dim3(xo + nx, 8 + ty, H + tz))
-    xs = slice(xo, xo + nx)
+    wxs = slice(xo, xo + nx)  # compute columns within a window row
 
     def kernel(*refs):
         curr_hbm = refs[:NF]
@@ -288,7 +320,12 @@ def make_pallas_substep(
 
         # derivatives + physics over the tile, via the shared fd/equations
         # implementation (reference: solve<step>, user_kernels.h:437-469)
-        fds = [field_data(_SlabView(win, (f,)), rect, ids) for f in range(NF)]
+        fds = [
+            field_data(
+                _SlabView(win, (f,), wrap_nx=nx if tight_x else None), rect, ids
+            )
+            for f in range(NF)
+        ]
         lnrho, uux, uuy, uuz, ax, ay, az, ss = fds
         uu = (uux, uuy, uuz)
         aa = (ax, ay, az)
@@ -301,17 +338,21 @@ def make_pallas_substep(
         rates[7] = entropy(c, ss, uu, lnrho, aa)
 
         for f in range(NF):
-            curr_c = win[f, H : H + tz, 8 : 8 + ty, :]
+            curr_c = win[f, H : H + tz, 8 : 8 + ty, wxs]
             if substep:
-                old = out_v[s3, f, :, :, xs]
-                new = curr_c[:, :, xs] + beta * (
-                    alpha_over_pb * (curr_c[:, :, xs] - old) + rates[f] * dt
+                old = out_v[s3, f, :, :, wxs]
+                new = curr_c + beta * (
+                    alpha_over_pb * (curr_c - old) + rates[f] * dt
                 )
             else:
-                new = curr_c[:, :, xs] + beta * dt * rates[f]
-            # non-compute columns carry curr so the store covers whole rows
-            out_v[s3, f] = curr_c
-            out_v[s3, f, :, :, xs] = new
+                new = curr_c + beta * dt * rates[f]
+            if tight_x:
+                out_v[s3, f] = new  # full rows ARE the compute columns
+            else:
+                # non-compute columns carry curr so the store covers whole
+                # aligned rows
+                out_v[s3, f] = win[f, H : H + tz, 8 : 8 + ty, :]
+                out_v[s3, f, :, :, wxs] = new
 
         for f in range(NF):
             out_dma(s3, t, f).start()

@@ -87,6 +87,23 @@ def _pick_tiles(nz: int, ny: int, yo: int, py: int, px: int) -> Tuple[int, int]:
     return best[1]
 
 
+def _tight_x_layout(wrap_x: bool, nx: int, xo: int, px: int):
+    """``(tight, kx, xo_k)`` — whether slabs can carry exactly the nx
+    compute columns. Mosaic proves 128-divisibility of minor-dim tile
+    indices on BOTH sides of a DMA (offsets and widths), so tight slabs
+    require the zero-x-radius layout (``Radius.without_x``: xo == 0,
+    px == nx); the periodic x neighborhood then comes from lane rolls.
+    Measured 1.36x on the one-step sweep at 512^3 (BASELINE.md round 3,
+    scripts/probe_xhalo.py)."""
+    tight = wrap_x and nx % 128 == 0 and xo % 128 == 0
+    return tight, (nx if tight else px), (0 if tight else xo)
+
+
+def _roll_x_pair(arr, nx: int, axis: int):
+    """Periodic (x-1, x+1) neighbor planes of ``arr`` by lane roll."""
+    return pltpu.roll(arr, 1, axis), pltpu.roll(arr, nx - 1, axis)
+
+
 def make_pallas_jacobi_sweep(
     spec: GridSpec,
     sel_z_range: Tuple[int, int],
@@ -112,9 +129,11 @@ def make_pallas_jacobi_sweep(
     off = spec.compute_offset()
     zo, yo, xo = off.z, off.y, off.x
     nz, ny, nx = spec.base.z, spec.base.y, spec.base.x
-    tz, ty = _pick_tiles(nz, ny, yo, py, px)
     sel_lo, sel_hi = sel_z_range
     wz, wy, wx = wrap
+
+    tight_x, kx, xo_k = _tight_x_layout(wx, nx, xo, px)
+    tz, ty = _pick_tiles(nz, ny, yo, py, kx)
 
     n_tz = nz // tz
     n_ty = ny // ty
@@ -125,7 +144,7 @@ def make_pallas_jacobi_sweep(
     # slab-local row index of the first output row (row-tiled slabs fetch
     # from y0 - 8, the nearest tile boundary carrying the -1 halo row)
     oy = yo if full_rows else 8
-    xs = slice(xo, xo + nx)
+    xs = slice(xo_k, xo_k + nx)
 
     def kernel(curr_hbm, nxt_hbm, sel_hbm, out_hbm, in_v, out_v, sel_v, wy_v, s_in, s_out, s_sel, s_wrap):
         t = pl.program_id(0)
@@ -137,25 +156,25 @@ def make_pallas_jacobi_sweep(
             yi = ti % n_ty
             return zo + zi * tz, yo + yi * ty  # first output plane / row
 
+        def _xsl():
+            return pl.ds(xo, nx) if tight_x else slice(None)
+
         def in_dma(s, ti):
             z0, y0 = tile_zy(ti)
-            src = curr_hbm.at[pl.ds(z0 - 1, tz + 2)]
-            if not full_rows:
-                src = curr_hbm.at[pl.ds(z0 - 1, tz + 2), pl.ds(y0 - 8, rows_in)]
+            ys = slice(None) if full_rows else pl.ds(y0 - 8, rows_in)
+            src = curr_hbm.at[pl.ds(z0 - 1, tz + 2), ys, _xsl()]
             return pltpu.make_async_copy(src, in_v.at[s], s_in.at[s])
 
         def sel_dma(s, ti):
             z0, y0 = tile_zy(ti)
-            src = sel_hbm.at[pl.ds(z0, tz)]
-            if not full_rows:
-                src = sel_hbm.at[pl.ds(z0, tz), pl.ds(y0, ty)]
+            ys = slice(None) if full_rows else pl.ds(y0, ty)
+            src = sel_hbm.at[pl.ds(z0, tz), ys, _xsl()]
             return pltpu.make_async_copy(src, sel_v.at[s], s_sel.at[s])
 
         def out_dma(s, ti):
             z0, y0 = tile_zy(ti)
-            dst = out_hbm.at[pl.ds(z0, tz)]
-            if not full_rows:
-                dst = out_hbm.at[pl.ds(z0, tz), pl.ds(y0, ty)]
+            ys = slice(None) if full_rows else pl.ds(y0, ty)
+            dst = out_hbm.at[pl.ds(z0, tz), ys, _xsl()]
             return pltpu.make_async_copy(out_v.at[s], dst, s_out.at[s])
 
         def touches_sel(ti):
@@ -190,18 +209,16 @@ def make_pallas_jacobi_sweep(
 
             @pl.when(zi == 0)
             def _():
-                src = curr_hbm.at[pl.ds(zo + nz - 1, 1)]
-                if not full_rows:
-                    src = curr_hbm.at[pl.ds(zo + nz - 1, 1), pl.ds(y0 - 8, rows_in)]
+                ys = slice(None) if full_rows else pl.ds(y0 - 8, rows_in)
+                src = curr_hbm.at[pl.ds(zo + nz - 1, 1), ys, _xsl()]
                 cp = pltpu.make_async_copy(src, in_v.at[slot, pl.ds(0, 1)], s_wrap)
                 cp.start()
                 cp.wait()
 
             @pl.when(zi == n_tz - 1)
             def _():
-                src = curr_hbm.at[pl.ds(zo, 1)]
-                if not full_rows:
-                    src = curr_hbm.at[pl.ds(zo, 1), pl.ds(y0 - 8, rows_in)]
+                ys = slice(None) if full_rows else pl.ds(y0 - 8, rows_in)
+                src = curr_hbm.at[pl.ds(zo, 1), ys, _xsl()]
                 cp = pltpu.make_async_copy(src, in_v.at[slot, pl.ds(tz + 1, 1)], s_wrap)
                 cp.start()
                 cp.wait()
@@ -217,7 +234,8 @@ def make_pallas_jacobi_sweep(
             @pl.when(yi == 0)
             def _():
                 cp = pltpu.make_async_copy(
-                    curr_hbm.at[pl.ds(z0, tz), pl.ds(yo + ny - 8, 8)], wy_v, s_wrap
+                    curr_hbm.at[pl.ds(z0, tz), pl.ds(yo + ny - 8, 8), _xsl()],
+                    wy_v, s_wrap
                 )
                 cp.start()
                 cp.wait()
@@ -226,20 +244,27 @@ def make_pallas_jacobi_sweep(
             @pl.when(yi == n_ty - 1)
             def _():
                 cp = pltpu.make_async_copy(
-                    curr_hbm.at[pl.ds(z0, tz), pl.ds(yo, 8)], wy_v, s_wrap
+                    curr_hbm.at[pl.ds(z0, tz), pl.ds(yo, 8), _xsl()],
+                    wy_v, s_wrap
                 )
                 cp.start()
                 cp.wait()
                 in_v[slot, 1 : tz + 1, oy + ty, :] = wy_v[:, 0, :]
 
-        if wx:
+        if wx and not tight_x:
             in_v[slot, :, :, xo - 1] = in_v[slot, :, :, xo + nx - 1]
             in_v[slot, :, :, xo + nx] = in_v[slot, :, :, xo]
 
         ctr = slice(oy, oy + ty)  # output rows within the in slab's center
+        if tight_x:
+            # periodic x neighborhood by lane roll — no halo columns exist
+            x_lo, x_hi = _roll_x_pair(in_v[slot, 1 : tz + 1, ctr, :], nx, 2)
+        else:
+            x_lo = in_v[slot, 1 : tz + 1, ctr, xo - 1 : xo + nx - 1]
+            x_hi = in_v[slot, 1 : tz + 1, ctr, xo + 1 : xo + nx + 1]
         avg = (
-            in_v[slot, 1 : tz + 1, ctr, xo - 1 : xo + nx - 1]
-            + in_v[slot, 1 : tz + 1, ctr, xo + 1 : xo + nx + 1]
+            x_lo
+            + x_hi
             + in_v[slot, 1 : tz + 1, oy - 1 : oy + ty - 1, xs]
             + in_v[slot, 1 : tz + 1, oy + 1 : oy + ty + 1, xs]
             + in_v[slot, 0:tz, ctr, xs]
@@ -253,13 +278,15 @@ def make_pallas_jacobi_sweep(
             out_dma(slot, t - 2).wait()
 
         # non-compute cells in the written range carry the input's values so
-        # the store can cover whole aligned rows
+        # the store can cover whole aligned rows (tight-x stores span
+        # exactly the compute columns — no x carries exist)
         oys = slice(oy, oy + ty) if full_rows else slice(None)
         if full_rows:
             out_v[slot, :, 0:oy, :] = in_v[slot, 1 : tz + 1, 0:oy, :]
             out_v[slot, :, oy + ty :, :] = in_v[slot, 1 : tz + 1, oy + ty : rows_out, :]
-        out_v[slot, :, oys, 0:xo] = in_v[slot, 1 : tz + 1, ctr, 0:xo]
-        out_v[slot, :, oys, xo + nx :] = in_v[slot, 1 : tz + 1, ctr, xo + nx : px]
+        if not tight_x:
+            out_v[slot, :, oys, 0:xo] = in_v[slot, 1 : tz + 1, ctr, 0:xo]
+            out_v[slot, :, oys, xo + nx :] = in_v[slot, 1 : tz + 1, ctr, xo + nx : px]
 
         @pl.when(touches_sel(t))
         def _():
@@ -298,10 +325,10 @@ def make_pallas_jacobi_sweep(
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.VMEM((2, tz + 2, rows_in, px), jnp.float32),
-            pltpu.VMEM((2, tz, rows_out, px), jnp.float32),
-            pltpu.VMEM((2, tz, rows_out, px), jnp.int32),
-            pltpu.VMEM((tz, 8, px), jnp.float32),  # wy staging
+            pltpu.VMEM((2, tz + 2, rows_in, kx), jnp.float32),
+            pltpu.VMEM((2, tz, rows_out, kx), jnp.float32),
+            pltpu.VMEM((2, tz, rows_out, kx), jnp.int32),
+            pltpu.VMEM((tz, 8, kx), jnp.float32),  # wy staging
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
@@ -380,7 +407,8 @@ def make_pallas_jacobi_multistep(
     hot_c = (g.x // 3, g.y // 2, g.z // 2)
     cold_c = (g.x * 2 // 3, g.y // 2, g.z // 2)
     thresh = (g.x // 10 + 1) ** 2
-    xs = slice(xo, xo + nx)
+    tight_x, kx, xo_k = _tight_x_layout(not mx, nx, xo, px)
+    xs = slice(xo_k, xo_k + nx)
     N_IN = 4  # input ring: 3 live planes + 1 in flight
 
     def ext(s):
@@ -399,11 +427,14 @@ def make_pallas_jacobi_multistep(
             ozv = oyv = oxv = 0
         j = pl.program_id(0)
 
+        def _xsl():
+            return pl.ds(xo, nx) if tight_x else slice(None)
+
         def out_dma(step):
             ph = zo + (step - 2 * k)
             return pltpu.make_async_copy(
                 out_v.at[pl.ds(jnp.mod(step, 2), 1)],
-                out_hbm.at[pl.ds(ph, 1)],
+                out_hbm.at[pl.ds(ph, 1), slice(None), _xsl()],
                 s_out.at[jnp.mod(step, 2)],
             )
 
@@ -413,7 +444,7 @@ def make_pallas_jacobi_multistep(
             else:
                 ph = zo + jnp.mod(step - k, nz)  # wrapped physical plane
             return pltpu.make_async_copy(
-                curr_hbm.at[pl.ds(ph, 1)],
+                curr_hbm.at[pl.ds(ph, 1), slice(None), _xsl()],
                 in_v.at[pl.ds(jnp.mod(step, N_IN), 1)],
                 s_in.at[jnp.mod(step, N_IN)],
             )
@@ -433,11 +464,11 @@ def make_pallas_jacobi_multistep(
             extents are extended (ey, ex) into the halo (multi-block axes);
             the ring spans the full valid extent so the next stage's
             shifted reads stay within filled cells."""
-            xw = slice(xo - ex, xo + nx + ex)
+            xw = slice(xo_k - ex, xo_k + nx + ex)
             if not my:
                 ref[slot, yo - 1, xw] = ref[slot, yo + ny - 1, xw]
                 ref[slot, yo + ny, xw] = ref[slot, yo, xw]
-            if not mx:
+            if not mx and not tight_x:
                 ry = 0 if my else 1
                 yw = slice(yo - ey - ry, yo + ny + ey + ry)
                 ref[slot, yw, xo - 1] = ref[slot, yw, xo + nx - 1]
@@ -464,10 +495,15 @@ def make_pallas_jacobi_multistep(
                     return ref[s - 2, slot, ys, xsl]
 
                 cy = slice(yo - ey, yo + ny + ey)
-                cx = slice(xo - ex, xo + nx + ex)
+                cx = slice(xo_k - ex, xo_k + nx + ex)
+                if tight_x:
+                    x_lo, x_hi = _roll_x_pair(rd(v, cy, cx), nx, 1)
+                else:
+                    x_lo = rd(v, cy, slice(xo_k - ex - 1, xo_k + nx + ex - 1))
+                    x_hi = rd(v, cy, slice(xo_k - ex + 1, xo_k + nx + ex + 1))
                 avg = (
-                    rd(v, cy, slice(xo - ex - 1, xo + nx + ex - 1))
-                    + rd(v, cy, slice(xo - ex + 1, xo + nx + ex + 1))
+                    x_lo
+                    + x_hi
                     + rd(v, slice(yo - ey - 1, yo + ny + ey - 1), cx)
                     + rd(v, slice(yo - ey + 1, yo + ny + ey + 1), cx)
                     + rd(v - 1, cy, cx)
@@ -532,9 +568,9 @@ def make_pallas_jacobi_multistep(
     else:
         out_shape = jax.ShapeDtypeStruct((pz, py, px), jnp.float32, vma=frozenset(vma))
     scratch = [
-        pltpu.VMEM((N_IN, py, px), jnp.float32),
-        pltpu.VMEM((max(k - 1, 1), 3, py, px), jnp.float32),
-        pltpu.VMEM((2, py, px), jnp.float32),
+        pltpu.VMEM((N_IN, py, kx), jnp.float32),
+        pltpu.VMEM((max(k - 1, 1), 3, py, kx), jnp.float32),
+        pltpu.VMEM((2, py, kx), jnp.float32),
         pltpu.SemaphoreType.DMA((N_IN,)),
         pltpu.SemaphoreType.DMA((2,)),
     ]

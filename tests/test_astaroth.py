@@ -552,3 +552,68 @@ def test_oversubscribed_distributed_step_matches_reference():
     for k in FIELDS:
         np.testing.assert_allclose(got[k], ref_curr[k], rtol=1e-10, atol=1e-12,
                                    err_msg=k)
+
+
+def test_reductions_on_oversubscribed_mesh():
+    """Masked reductions with 2 z-blocks resident per device: the local
+    reduce spans the residents, the collectives run over the smaller mesh."""
+    n = 8
+    spec = GridSpec(Dim3(n, n, n), Dim3(2, 2, 2), Radius.constant(3))
+    mesh = grid_mesh(Dim3(2, 2, 1), jax.devices()[:4])
+    ex = HaloExchange(spec, mesh)
+    assert ex.resident_z == 2
+    rng = np.random.RandomState(3)
+    f = rng.randn(n, n, n)
+    red = Reductions(ex)
+    got = red.scal(shard_blocks(f, spec, mesh))
+    assert got["max"] == pytest.approx(f.max())
+    assert got["min"] == pytest.approx(f.min())
+    assert got["sum"] == pytest.approx(f.sum(), rel=1e-12)
+    assert got["rms"] == pytest.approx(np.sqrt((f**2).mean()), rel=1e-12)
+
+
+def test_tight_x_layout_matches_inline_reference():
+    """Radius.without_x on a single block (px == nx, x pencils via lane
+    rolls): the fused substep must match the global np.roll reference,
+    exactly like the inline-halo layout does."""
+    nx, ny, nz = 128, 16, 14
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = nx
+    info.int_params["AC_ny"] = ny
+    info.int_params["AC_nz"] = nz
+    info.update_builtin_params()
+    dt = 1e-3
+    size = Dim3(nx, ny, nz)
+    rng = np.random.RandomState(17)
+    fields = {
+        k: (rng.randn(nz, ny, nx) * 0.05).astype(np.float32) for k in FIELDS
+    }
+    fields["lnrho"] = fields["lnrho"] + np.float32(0.5)
+
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(3).without_x())
+    assert spec.padded().x == nx and spec.compute_offset().x == 0
+    from stencil_tpu.ops.pallas_astaroth import substep_supported
+    import jax.numpy as jnp
+    assert substep_supported(spec, jnp.float32)
+    mesh = grid_mesh(spec.dim, jax.devices()[:1])
+    ex = HaloExchange(spec, mesh)
+    step = make_astaroth_step(ex, info, dt=dt, dtype="float32",
+                              use_pallas=True, interpret=True)
+    curr = {k: shard_blocks(fields[k], spec, mesh) for k in FIELDS}
+    nxt = {
+        k: shard_blocks(np.zeros((nz, ny, nx), np.float32), spec, mesh)
+        for k in FIELDS
+    }
+    for _ in range(2):
+        curr, nxt = step(curr, nxt)
+    got = {k: unshard_blocks(curr[k], spec) for k in FIELDS}
+
+    f64 = {k: fields[k].astype(np.float64) for k in FIELDS}
+    ref_out = {k: np.zeros((nz, ny, nx)) for k in FIELDS}
+    ref_curr, ref_out = global_reference_iteration(dict(f64), ref_out, info, dt)
+    ref_curr, _ = global_reference_iteration(ref_curr, ref_out, info, dt)
+    for k in FIELDS:
+        np.testing.assert_allclose(got[k], ref_curr[k], rtol=2e-4, atol=1e-6,
+                                   err_msg=k)

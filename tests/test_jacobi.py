@@ -439,3 +439,135 @@ def test_oversubscribed_jacobi_matches_reference():
     field = np.full((size.z, size.y, size.x), INIT_TEMP, dtype=np.float32)
     want = jacobi_reference(field, masks, iters)
     np.testing.assert_allclose(a, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_sweep_lane_aligned_inline_matches_xla():
+    """Lane-aligned nx (128) with INLINE halos (radius 1, xo == 1): the
+    tight-x gate must stay off (DMA slice offsets must be 128-divisible,
+    ops/pallas_stencil._tight_x_layout) and the inline path must match the
+    XLA step bit-for-bit. The engaged tight path is pinned separately by
+    test_zero_x_radius_tight_layout_matches_reference."""
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.jacobi import make_jacobi_step, sphere_sel
+    from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_sweep
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
+
+    size = Dim3(128, 16, 12)  # x self-wraps and is lane-aligned
+    spec = GridSpec(size, Dim3(1, 2, 1), Radius.constant(1))
+    mesh = grid_mesh(spec.dim, jax.devices()[:2])
+    ex = HaloExchange(spec, mesh)
+    rng = np.random.RandomState(12)
+    field = rng.rand(size.z, size.y, size.x).astype(np.float32)
+    sel = shard_blocks(sphere_sel(size), spec, mesh)
+
+    outs = {}
+    for label, kwargs in (
+        ("pallas", dict(use_pallas=True, interpret=True)),
+        ("xla", dict(use_pallas=False)),
+    ):
+        step = make_jacobi_step(ex, **kwargs)
+        curr = shard_blocks(field, spec, mesh)
+        nxt = shard_blocks(np.zeros_like(field), spec, mesh)
+        for _ in range(2):
+            curr, nxt = step(curr, nxt, sel)
+        outs[label] = unshard_blocks(curr, spec)
+    np.testing.assert_array_equal(outs["pallas"], outs["xla"])
+
+
+def test_pallas_multistep_lane_aligned_inline_matches_reference():
+    """Lane-aligned x (nx % 128 == 0) with INLINE halos (radius 1,
+    xo == 1): the multistep's tight-x gate stays off and the inline path
+    must equal k applications of the numpy periodic reference. The
+    engaged tight multistep (zero-x-radius layout) is pinned by
+    test_zero_x_radius_tight_layout_matches_reference (k=4) and
+    test_zero_x_radius_tight_multistep_deep_k below."""
+    import jax.numpy as jnp
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_multistep
+
+    k = 3
+    size = Dim3(128, 16, 12)
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1))
+    p = spec.padded()
+    off = spec.compute_offset()
+    fn = make_pallas_jacobi_multistep(spec, k, interpret=True)
+    rng = np.random.RandomState(0)
+    curr = np.zeros((p.z, p.y, p.x), np.float32)
+    sl = (
+        slice(off.z, off.z + size.z),
+        slice(off.y, off.y + size.y),
+        slice(off.x, off.x + size.x),
+    )
+    curr[sl] = rng.rand(size.z, size.y, size.x)
+    got = np.asarray(fn(jnp.asarray(curr), jnp.zeros_like(curr)))[sl]
+    want = jacobi_reference(curr[sl], sphere_masks(size), k).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_zero_x_radius_tight_layout_matches_reference():
+    """Radius.without_x on a single block (no x halo columns allocated,
+    px == nx): both the one-step sweep and the fused multistep must match
+    the periodic numpy reference in interpret mode."""
+    import jax.numpy as jnp
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.jacobi import (
+        make_jacobi_loop, make_jacobi_step, sphere_sel,
+    )
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
+
+    size = Dim3(128, 16, 12)
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1).without_x())
+    assert spec.padded().x == 128 and spec.compute_offset().x == 0
+    mesh = grid_mesh(spec.dim, jax.devices()[:1])
+    ex = HaloExchange(spec, mesh)
+    rng = np.random.RandomState(13)
+    field = rng.rand(size.z, size.y, size.x).astype(np.float32)
+    sel = shard_blocks(sphere_sel(size), spec, mesh)
+    masks = sphere_masks(size)
+
+    for iters, maker in ((1, lambda: make_jacobi_step(
+            ex, use_pallas=True, interpret=True)),
+                         (4, lambda: make_jacobi_loop(
+            ex, 4, use_pallas=True, interpret=True))):
+        step = maker()
+        curr = shard_blocks(field, spec, mesh)
+        nxt = shard_blocks(np.zeros_like(field), spec, mesh)
+        curr, nxt = step(curr, nxt, sel)
+        got = unshard_blocks(curr, spec)
+        want = jacobi_reference(field, masks, iters).astype(np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"iters={iters}")
+
+
+def test_zero_x_radius_tight_multistep_deep_k():
+    """The engaged tight-x multistep at k=5, called directly: k fused
+    wavefront steps over a zero-x-radius block (x wrap via lane rolls)
+    must equal k applications of the numpy periodic reference."""
+    import jax.numpy as jnp
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_multistep
+
+    k = 5
+    size = Dim3(128, 16, 12)
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1).without_x())
+    assert spec.padded().x == 128 and spec.compute_offset().x == 0
+    p = spec.padded()
+    off = spec.compute_offset()
+    fn = make_pallas_jacobi_multistep(spec, k, interpret=True)
+    rng = np.random.RandomState(0)
+    curr = np.zeros((p.z, p.y, p.x), np.float32)
+    sl = (
+        slice(off.z, off.z + size.z),
+        slice(off.y, off.y + size.y),
+        slice(off.x, off.x + size.x),
+    )
+    curr[sl] = rng.rand(size.z, size.y, size.x)
+    got = np.asarray(fn(jnp.asarray(curr), jnp.zeros_like(curr)))[sl]
+    want = jacobi_reference(curr[sl], sphere_masks(size), k).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
