@@ -1,0 +1,43 @@
+"""512^3 astaroth substep tile/budget retune under the tight-x layout:
+is the 22 MB scratch budget leaving tile-shape performance on the table?
+(the VMEM compile ceiling probe said ~34 MB still compiles)"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+import stencil_tpu.ops.pallas_astaroth as pa
+from stencil_tpu.astaroth.config import load_config
+from stencil_tpu.astaroth.equations import Constants
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = 512
+spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3).without_x())
+info, _ = load_config("stencil_tpu/astaroth/astaroth.conf")
+c = Constants.from_info(info)
+inv_ds = tuple(info.real_params[k] for k in ("AC_inv_dsx", "AC_inv_dsy", "AC_inv_dsz"))
+p = spec.padded()
+rng = np.random.RandomState(7)
+curr = tuple(jnp.asarray(rng.rand(p.z, p.y, p.x) * 0.1, jnp.float32) for _ in pa.FIELDS)
+out_np = rng.rand(p.z, p.y, p.x) * 0.1
+chunk = 12
+print(f"auto pick: {pa.pick_tiles(spec)}", flush=True)
+for tiles in (None, (2, 64), (2, 128), (4, 64), (1, 256)):
+    out = tuple(jnp.asarray(out_np, jnp.float32) for _ in pa.FIELDS)
+    label = tiles or pa.pick_tiles(spec)
+    try:
+        mb = pa.scratch_bytes(spec, *(tiles or pa.pick_tiles(spec))) / 2**20
+        sub = pa.make_pallas_substep(spec, c, inv_ds, 1, 1e-8, tiles=tiles)
+        fn = jax.jit(lambda cu, ou: jax.lax.fori_loop(
+            0, chunk, lambda _, o: sub(cu, o), ou), donate_argnums=(1,))
+        t0 = time.time(); out2 = fn(curr, out); hard_sync(out2)
+        cs = time.time() - t0
+        st = Statistics()
+        for _ in range(3):
+            t0 = time.perf_counter(); out2 = fn(curr, out2); hard_sync(out2)
+            st.insert((time.perf_counter() - t0) / chunk)
+        print(f"tiles {label} ({mb:.1f} MB): {st.trimean()*1e3:.2f} ms/substep "
+              f"(compile {cs:.0f}s)", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"tiles {label}: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)

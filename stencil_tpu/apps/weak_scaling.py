@@ -53,9 +53,9 @@ from . import bench_exchange, exchange_weak, jacobi3d, measure_overlap
 # deep_halo=4), NOT the 512^3 headline, so the efficiency column compares
 # like with like.
 DEFAULT_BASE = {
-    "jacobi_mcells_per_s_per_dev": 13216.0,  # 256^3 deep_halo=4 fused loop
-    "exchange_weak_trimean_s": 5.21e-3,      # 512^3 radius-3 4q self-wrap fill
-    "config2_trimean_s": 2.21e-3,            # 256^3 radius-2 4q self-wrap fill
+    "jacobi_mcells_per_s_per_dev": 15383.0,  # 256^3 deep_halo=4 fused loop
+    "exchange_weak_trimean_s": 5.42e-3,      # 512^3 radius-3 4q self-wrap fill
+    "config2_trimean_s": 2.00e-3,            # 256^3 radius-2 4q self-wrap fill
 }
 
 
