@@ -137,7 +137,8 @@ def probe_decomp():
     from stencil_tpu.utils.sync import hard_sync
 
     n = 256
-    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
+    # round-3 tight-x layout (the production single-chip path)
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3).without_x())
     info, _ = load_config("stencil_tpu/astaroth/astaroth.conf")
     c = Constants.from_info(info)
     inv_ds = (
@@ -184,8 +185,7 @@ def probe_decomp():
         if setup:
             setup()
         try:
-            sub = pa.make_pallas_substep(spec, c, inv_ds, 1, 1e-8,
-                                         tiles=(2, 128))
+            sub = pa.make_pallas_substep(spec, c, inv_ds, 1, 1e-8)
             out = tuple(jnp.asarray(out_np, jnp.float32) for _ in pa.FIELDS)
 
             def many(cu, ou):

@@ -1,0 +1,43 @@
+"""Pure stacked-substep timing (tight-x layout): the DMA-descriptor
+batching result for BASELINE.md. Usage: probe_stacked.py [n]"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from stencil_tpu.astaroth.config import load_config
+from stencil_tpu.astaroth.equations import Constants
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.ops.pallas_astaroth import FIELDS, NF, make_pallas_substep, pick_tiles
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+info, _ = load_config("stencil_tpu/astaroth/astaroth.conf")
+c = Constants.from_info(info)
+inv_ds = tuple(info.real_params[k] for k in ("AC_inv_dsx", "AC_inv_dsy", "AC_inv_dsz"))
+spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3).without_x())
+p = spec.padded()
+rng = np.random.RandomState(7)
+chunk = 60 if n <= 256 else 12
+for label, stacked in (("stacked", True), ("per-field", False)):
+    sub = make_pallas_substep(spec, c, inv_ds, 1, 1e-8, stacked=stacked)
+    if stacked:
+        curr = jnp.asarray(rng.rand(NF, p.z, p.y, p.x) * 0.1, jnp.float32)
+        out = jnp.asarray(rng.rand(NF, p.z, p.y, p.x) * 0.1, jnp.float32)
+        fn = jax.jit(lambda cu, ou: jax.lax.fori_loop(
+            0, chunk, lambda _, o: sub(cu, o), ou), donate_argnums=(1,))
+    else:
+        curr = tuple(jnp.asarray(rng.rand(p.z, p.y, p.x) * 0.1, jnp.float32)
+                     for _ in FIELDS)
+        out = tuple(jnp.asarray(rng.rand(p.z, p.y, p.x) * 0.1, jnp.float32)
+                    for _ in FIELDS)
+        fn = jax.jit(lambda cu, ou: jax.lax.fori_loop(
+            0, chunk, lambda _, o: sub(cu, o), ou), donate_argnums=(1,))
+    t0 = time.time(); out2 = fn(curr, out); hard_sync(out2)
+    cs = time.time() - t0
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter(); out2 = fn(curr, out2); hard_sync(out2)
+        st.insert((time.perf_counter() - t0) / chunk)
+    print(f"{label} {n}^3 tiles={pick_tiles(spec)}: {st.trimean()*1e3:.2f} "
+          f"ms/substep (compile {cs:.0f}s)", flush=True)
