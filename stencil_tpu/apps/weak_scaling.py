@@ -31,6 +31,12 @@ Usage:
   python -m stencil_tpu.apps.weak_scaling                  # real chips
   python -m stencil_tpu.apps.weak_scaling --cpu 8 --smoke  # virtual mesh
   python -m stencil_tpu.apps.weak_scaling --record-base    # on 1 chip
+
+Dispatch-overhead caveat: iterations run in fused chunks of ``iters // 3``.
+On the tunneled single-chip platform (~87 ms/dispatch) the efficiency
+columns are only apples-to-apples when runs use the same ``--iters`` as
+``--record-base`` (default 360); on a real pod slice dispatch cost is
+negligible and any iters works.
 """
 
 from __future__ import annotations
@@ -75,12 +81,19 @@ def run(
     use_pallas: Optional[bool] = None,
     overlap_rounds: int = 3,
     deep_halo: int = 4,
-    chunk: int = 10,
+    chunk: Optional[int] = None,
 ) -> dict:
-    """Run configs 2/3/5 on ``devices`` and return rows + efficiencies."""
+    """Run configs 2/3/5 on ``devices`` and return rows + efficiencies.
+
+    ``chunk`` (iterations fused per dispatch) defaults to ``iters // 3`` —
+    the anchors are recorded with large chunks, and a small chunk makes the
+    efficiency columns measure dispatch overhead instead of scaling
+    (~87 ms per dispatch on the tunneled platform)."""
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
     base = dict(DEFAULT_BASE, **(base or {}))
+    if chunk is None:
+        chunk = max(1, iters // 3)
     rows = []
 
     # -- config 2: fixed global exchange ------------------------------------
