@@ -1,0 +1,48 @@
+"""Timing probe: the 512^3 tight-x multistep with the per-stage y-ring
+fill copies REMOVED (results wrong) — sizes the payoff of a tight-y
+(zero-y-radius, sublane-roll) layout before building it."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+import stencil_tpu.ops.pallas_stencil as ps
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+k = 10
+spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(1).without_x())
+p = spec.padded()
+rng = np.random.RandomState(0)
+x0 = jnp.asarray(rng.rand(p.z, p.y, p.x), jnp.float32)
+
+for label, patch in (("with-yfill", False), ("no-yfill", True)):
+    if patch:
+        orig = ps.make_pallas_jacobi_multistep
+
+        # rebuild with fill_wrap neutered via source-level monkeypatch of
+        # the kernel's fill: easiest is to shadow pltpu-roll? Instead use
+        # a wrapper module attribute the kernel reads.
+        ps._SKIP_YFILL = True
+    else:
+        ps._SKIP_YFILL = False
+    fn = ps.make_pallas_jacobi_multistep(spec, k)
+    chunk = 12
+
+    def many(a):
+        def body(_, cn):
+            c, x = cn
+            return (fn(c, x), c)
+        return jax.lax.fori_loop(0, chunk, body, (a, a))[0]
+
+    g = jax.jit(many)
+    t0 = time.time(); r = g(x0); hard_sync(r)
+    cs = time.time() - t0
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter(); r = g(r); hard_sync(r)
+        st.insert((time.perf_counter() - t0) / chunk / k)
+    print(f"{label} {n}^3 k={k}: {st.trimean()*1e3:.3f} ms/step "
+          f"({n**3/ (st.trimean())/1e6:.0f} Mcells/s) compile {cs:.0f}s",
+          flush=True)

@@ -49,6 +49,10 @@ from .jacobi import COLD_TEMP, HOT_TEMP
 # VMEM scratch budget (~16 MB/core on v5e; leave headroom for the compiler)
 _VMEM_BUDGET = 12 * 1024 * 1024
 
+# timing probe only (scripts/probe_noyfill.py): skip the multistep's y-ring
+# fills to size a tight-y layout's payoff; results are WRONG when set
+_SKIP_YFILL = False
+
 
 def _divisors_desc(n: int, cands) -> list:
     out = [c for c in cands if c <= n and n % c == 0]
@@ -465,7 +469,7 @@ def make_pallas_jacobi_multistep(
             the ring spans the full valid extent so the next stage's
             shifted reads stay within filled cells."""
             xw = slice(xo_k - ex, xo_k + nx + ex)
-            if not my:
+            if not my and not _SKIP_YFILL:
                 ref[slot, yo - 1, xw] = ref[slot, yo + ny - 1, xw]
                 ref[slot, yo + ny, xw] = ref[slot, yo, xw]
             if not mx and not tight_x:
