@@ -27,16 +27,20 @@ _SCRIPT = os.path.join(_REPO, "scripts", "export_overlap_hlo.py")
 
 def _report(which: str) -> dict:
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    proc = subprocess.run(
-        [sys.executable, _SCRIPT, which],
-        capture_output=True,
-        text=True,
-        timeout=600,
-        env=env,
-        cwd=_REPO,
-    )
-    assert proc.returncode == 0, f"{which}: {proc.stderr[-3000:]}"
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+    last = None
+    for _ in range(2):  # lowering is host-heavy; retry once under load
+        proc = subprocess.run(
+            [sys.executable, _SCRIPT, which],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=_REPO,
+        )
+        last = proc
+        if proc.returncode == 0:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+    assert last.returncode == 0, f"{which}: {last.stderr[-3000:]}"
 
 
 def test_jacobi_pallas_overlap_dataflow():
