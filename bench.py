@@ -1,39 +1,78 @@
 #!/usr/bin/env python
 """Headline benchmark: jacobi3d Mcells/s/chip at 512^3 (reference default
-size, bin/jacobi3d.cu:100-102) plus halo-exchange GB/s, printed as ONE JSON
-line. Runs on whatever accelerator JAX finds (the driver provides one TPU
-chip); falls back to a small CPU run if only CPU is available.
+size, bin/jacobi3d.cu:100-102) plus halo-exchange GB/s and the astaroth
+flagship details, printed as ONE JSON line with rc=0 — always.
 
-vs_baseline compares against this repo's recorded round-1 TPU numbers in
-BASELINE.md (the reference publishes no absolute numbers — BASELINE.md §1).
+Architecture (round-4 hardening): the PARENT process never initializes a
+JAX backend. The tunneled TPU plugin can stall ``jax.devices()``
+indefinitely or die mid-``device_put`` (round-3 BENCH artifact, rc=1), so
+all measurement runs in CHILD subprocesses the parent can time out and
+retry:
+
+  1. accelerator child (whatever backend JAX finds — the driver's TPU chip),
+     retried once with backoff;
+  2. forced-CPU child (``jax.config.update('jax_platforms','cpu')`` before
+     backend init — the env-var spelling is ignored once the tunnel plugin
+     registers) with small sizes;
+  3. a last-resort static JSON line if even the CPU child fails.
+
+vs_baseline for the headline compares against this repo's recorded ROUND-1
+TPU number (the reference publishes no absolute numbers — BASELINE.md §1),
+so the driver sees the cumulative speedup (~23x as of round 3). The
+exchange ratio compares like-for-like against the ROUND-2 Pallas self-fill
+number measured with this exact leg (round 1's 2.18 GB/s was the
+pre-Pallas slab path; dividing by it conflated a kernel rewrite with a
+methodology change — VERDICT r3 weak #6).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import tempfile
 import time
 
-# Round-1 recorded TPU v5e-chip numbers (see BASELINE.md "Recorded numbers").
-BASELINE_MCELLS_PER_S_PER_CHIP = 3394.8
-BASELINE_EXCHANGE_GB_S = 2.18
+# Recorded TPU v5e single-chip numbers (BASELINE.md "Recorded numbers").
+BASELINE_MCELLS_PER_S_PER_CHIP = 3394.8  # round 1, jacobi3d 512^3
+BASELINE_EXCHANGE_GB_S = 15.75  # round 2, Pallas self-fill, same leg as below
+
+# The one JSON line the driver reads is marked so the parent can find it in
+# the child's stdout regardless of logging noise around it.
+SENTINEL = "STENCIL_BENCH_JSON: "
 
 
-def main() -> int:
-    import os
-    import sys
+# ---------------------------------------------------------------- child side
+
+
+def _child_main(mode: str) -> int:
+    """Measure and print SENTINEL+JSON. ``mode``: 'accel' | 'cpu'."""
+    hang = float(os.environ.get("STENCIL_BENCH_SELFTEST_HANG_S", "0") or 0)
+    if hang and mode == "accel":
+        # self-test hook (tests/test_driver_hardening.py): simulate the
+        # wedged-tunnel backend init the parent must be able to time out
+        time.sleep(hang)
 
     import jax
 
-    # wall-clock guard: the driver must ALWAYS get the one JSON line, even
-    # when the tunneled platform is slow — optional detail legs are skipped
-    # once the budget is spent (headline jacobi always runs)
-    budget_s = float(os.environ.get("STENCIL_BENCH_BUDGET_S", "900"))
-    bench_t0 = time.time()
+    if mode == "cpu":
+        # must go through the config API before backend init: the tunnel's
+        # sitecustomize pins JAX_PLATFORMS and the plugin ignores the env var
+        jax.config.update("jax_platforms", "cpu")
 
-    def leg(name):
-        left = budget_s - (time.time() - bench_t0)
-        print(f"[bench] {name}: {time.time()-bench_t0:.0f}s elapsed, "
-              f"{left:.0f}s budget left", file=sys.stderr, flush=True)
+    budget_s = float(os.environ.get("STENCIL_BENCH_LEG_BUDGET_S", "840"))
+    t0 = time.time()
+    errors: dict[str, str] = {}
+
+    def leg(name: str) -> bool:
+        left = budget_s - (time.time() - t0)
+        print(
+            f"[bench:{mode}] {name}: {time.time()-t0:.0f}s elapsed, "
+            f"{left:.0f}s budget left",
+            file=sys.stderr,
+            flush=True,
+        )
         return left > 0
 
     on_accel = jax.devices()[0].platform != "cpu"
@@ -41,14 +80,15 @@ def main() -> int:
     # the tunneled platform costs ~87 ms fixed per dispatch; large fused
     # chunks amortize it (the reference's >=30-iteration timing loops,
     # bin/exchange_weak.cu:168-177, served the same purpose for CUDA
-    # launch/MPI overhead)
-    # 360 amortizes the ~87 ms fixed dispatch cost to ~0.24 ms per iteration
+    # launch/MPI overhead). 360 amortizes to ~0.24 ms per iteration.
     chunk = 360 if on_accel else 3
 
     from stencil_tpu.apps.jacobi3d import run
     from stencil_tpu.utils.statistics import Statistics
     from stencil_tpu.utils.sync import hard_sync
 
+    # headline jacobi: REQUIRED — if this dies the child fails and the
+    # parent falls back
     leg("jacobi3d headline")
     r = run(n, n, n, iters=3 * chunk, weak=False, devices=jax.devices()[:1],
             warmup=1, chunk=chunk)
@@ -64,39 +104,54 @@ def main() -> int:
 
     ex_gb_s = 0.0
     if leg("halo exchange"):
-        spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
-        mesh = grid_mesh(spec.dim, jax.devices()[:1])
-        ex = HaloExchange(spec, mesh)
-        loop = ex.make_loop(chunk)
-        state = {
-            i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
-            for i in range(4)
-        }
-        state = loop(state)  # compile + warm
-        hard_sync(state)
-        st = Statistics()
-        for _ in range(3):
-            t0 = time.perf_counter()
-            state = loop(state)
+        try:
+            spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
+            mesh = grid_mesh(spec.dim, jax.devices()[:1])
+            ex = HaloExchange(spec, mesh)
+            loop = ex.make_loop(chunk)
+            state = {
+                i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
+                for i in range(4)
+            }
+            state = loop(state)  # compile + warm
             hard_sync(state)
-            st.insert((time.perf_counter() - t0) / chunk)
-        ex_gb_s = ex.bytes_logical([4] * 4) / st.trimean() / 1e9
-        del state
+            st = Statistics()
+            for _ in range(3):
+                t1 = time.perf_counter()
+                state = loop(state)
+                hard_sync(state)
+                st.insert((time.perf_counter() - t1) / chunk)
+            ex_gb_s = ex.bytes_logical([4] * 4) / st.trimean() / 1e9
+            del state
+        except Exception as e:  # optional leg: record, keep going
+            errors["exchange"] = f"{type(e).__name__}: {e}"[:400]
 
-    # astaroth flagship detail (BASELINE config 4 family): 256^3, 8 fp32
-    # fields, fused Pallas RK3 substeps; skipped off-accelerator, via
+    # astaroth flagship details (BASELINE configs 4/4b): 8 fp32 fields,
+    # fused Pallas RK3 substeps; skipped off-accelerator, via
     # STENCIL_BENCH_FAST=1, or when over budget (the three sliding-window
-    # substep kernels compile in ~50 s each)
+    # substep kernels compile in ~50 s each; the 512^3 set in ~150 s)
     asta_ms = None
-    if (on_accel and not os.environ.get("STENCIL_BENCH_FAST")
-            and leg("astaroth 256^3")):
+    asta512_ms = None
+    if on_accel and not os.environ.get("STENCIL_BENCH_FAST"):
         from stencil_tpu.apps.astaroth import run as asta_run
 
-        # chunk 30 amortizes the ~87 ms fixed dispatch cost to <3 ms/iter
-        a = asta_run(
-            iters=60, devices=jax.devices()[:1], dtype="float32", nx=256, chunk=30
-        )
-        asta_ms = round(a["iter_trimean_s"] * 1e3, 2)
+        if leg("astaroth 256^3"):
+            try:
+                # chunk 30 amortizes the ~87 ms dispatch cost to <3 ms/iter
+                a = asta_run(iters=60, devices=jax.devices()[:1],
+                             dtype="float32", nx=256, chunk=30)
+                asta_ms = round(a["iter_trimean_s"] * 1e3, 2)
+            except Exception as e:
+                errors["astaroth_256"] = f"{type(e).__name__}: {e}"[:400]
+        # the open flagship target (512^3 <= 180 ms/iter) is driver-tracked
+        # from round 4 on (VERDICT r3 item 8); needs ~180 s compile+run
+        if leg("astaroth 512^3") and budget_s - (time.time() - t0) > 200:
+            try:
+                a = asta_run(iters=12, devices=jax.devices()[:1],
+                             dtype="float32", nx=512, chunk=6)
+                asta512_ms = round(a["iter_trimean_s"] * 1e3, 2)
+            except Exception as e:
+                errors["astaroth_512"] = f"{type(e).__name__}: {e}"[:400]
     leg("done")
 
     value = round(mcells, 1)
@@ -109,28 +164,124 @@ def main() -> int:
         if comparable
         else f"jacobi3d_{n}_mcells_per_s_per_chip_cpu_fallback"
     )
+    detail = {
+        "iter_trimean_s": round(r["iter_trimean_s"], 6),
+        "exchange_gb_per_s_r3_4q": round(ex_gb_s, 2),
+        # like-for-like: same Pallas self-fill leg as the round-2 baseline
+        "exchange_vs_baseline": (
+            round(ex_gb_s / BASELINE_EXCHANGE_GB_S, 3) if comparable else 0.0
+        ),
+        "astaroth_256_iter_ms": asta_ms,
+        "astaroth_512_iter_ms": asta512_ms,
+        "platform": jax.devices()[0].platform,
+        "size": n,
+    }
+    if errors:
+        detail["leg_errors"] = errors
     print(
-        json.dumps(
+        SENTINEL
+        + json.dumps(
             {
                 "metric": metric,
                 "value": value,
                 "unit": "Mcells/s",
                 "vs_baseline": round(vs, 3),
-                "detail": {
-                    "iter_trimean_s": round(r["iter_trimean_s"], 6),
-                    "exchange_gb_per_s_r3_4q": round(ex_gb_s, 2),
-                    "exchange_vs_baseline": (
-                        round(ex_gb_s / BASELINE_EXCHANGE_GB_S, 3) if comparable else 0.0
-                    ),
-                    "astaroth_256_iter_ms": asta_ms,
-                    "platform": jax.devices()[0].platform,
-                    "size": n,
-                },
+                "detail": detail,
             }
-        )
+        ),
+        flush=True,
+    )
+    return 0
+
+
+# --------------------------------------------------------------- parent side
+
+
+def _run_child(mode: str, timeout_s: float) -> dict | None:
+    """Run one measurement child; return its JSON payload or None.
+
+    stdout/stderr go to temp files (the tunneled platform's partial output
+    dies in pipes when the child is killed on timeout)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", mode]
+    env = dict(os.environ)
+    env["STENCIL_BENCH_LEG_BUDGET_S"] = str(max(60.0, timeout_s - 60.0))
+    with tempfile.TemporaryFile(mode="w+") as out, \
+            tempfile.TemporaryFile(mode="w+") as err:
+        try:
+            proc = subprocess.run(
+                cmd, stdout=out, stderr=err, env=env, timeout=timeout_s
+            )
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            rc = -1
+            print(f"[bench] {mode} child timed out after {timeout_s:.0f}s",
+                  file=sys.stderr, flush=True)
+        out.seek(0)
+        err.seek(0)
+        stdout = out.read()
+        stderr_tail = err.read()[-2000:]
+    payload = None
+    for line in stdout.splitlines():
+        if line.startswith(SENTINEL):
+            try:
+                payload = json.loads(line[len(SENTINEL):])
+            except json.JSONDecodeError:
+                payload = None
+    if payload is None:
+        print(f"[bench] {mode} child produced no result (rc={rc});"
+              f" stderr tail:\n{stderr_tail}", file=sys.stderr, flush=True)
+    return payload
+
+
+def main() -> int:
+    budget_s = float(os.environ.get("STENCIL_BENCH_BUDGET_S", "900"))
+    t0 = time.time()
+
+    def remaining() -> float:
+        return budget_s - (time.time() - t0)
+
+    # schedule: accel try 1 (bulk of the budget), backoff, accel try 2,
+    # forced-CPU fallback (reserved slice), static last resort. Every
+    # floor is bounded by the budget itself so the total stays within
+    # ~budget + one minimal CPU try (a driver that kills at the stated
+    # budget must not be starved of the JSON line by our own floors).
+    # accel attempt 1 gets the lion's share: the astaroth 512^3 leg's gate
+    # needs ~260s left in the child after the earlier legs (~280s), so a
+    # 900s default budget must translate to a >=540s first-try leg budget
+    reserve_cpu = min(180.0, max(30.0, budget_s * 0.25))
+    avail = max(0.0, budget_s - reserve_cpu - 10.0)
+    plan = [("accel", avail * 0.85), ("accel", avail * 0.15)]
+    for i, (mode, timeout_s) in enumerate(plan):
+        if i > 0:
+            time.sleep(min(20.0, max(0.0, remaining() - reserve_cpu) / 4))
+        timeout_s = min(timeout_s, max(10.0, remaining() - reserve_cpu))
+        if timeout_s < 10.0:
+            continue  # not enough time to even import jax
+        payload = _run_child(mode, timeout_s)
+        if payload is not None:
+            print(json.dumps(payload), flush=True)
+            return 0
+    payload = _run_child("cpu", max(30.0, remaining() - 5.0))
+    if payload is not None:
+        print(json.dumps(payload), flush=True)
+        return 0
+    # last resort: the driver still gets its one line and rc=0
+    print(
+        json.dumps(
+            {
+                "metric": "jacobi3d_512_mcells_per_s_per_chip",
+                "value": 0.0,
+                "unit": "Mcells/s",
+                "vs_baseline": 0.0,
+                "detail": {"error": "all bench children failed; see stderr"},
+            }
+        ),
+        flush=True,
     )
     return 0
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        raise SystemExit(_child_main(sys.argv[2]))
     raise SystemExit(main())

@@ -24,3 +24,9 @@ jax.config.update("jax_platforms", "cpu")
 # the env-var spelling of this flag is ignored once the TPU plugin loads, so
 # set it through the config API.
 jax.config.update("jax_enable_x64", True)
+
+# Initialize the CPU backend eagerly: dryrun_multichip's parent-side probe
+# (_live_cpu_device_count) only trusts an ALREADY-initialized CPU backend, so
+# without this a standalone test_graft_entry run would fall to the (slower)
+# subprocess path.
+jax.devices()
