@@ -18,16 +18,9 @@ rng = np.random.RandomState(0)
 x0 = jnp.asarray(rng.rand(p.z, p.y, p.x), jnp.float32)
 
 for label, patch in (("with-yfill", False), ("no-yfill", True)):
-    if patch:
-        orig = ps.make_pallas_jacobi_multistep
-
-        # rebuild with fill_wrap neutered via source-level monkeypatch of
-        # the kernel's fill: easiest is to shadow pltpu-roll? Instead use
-        # a wrapper module attribute the kernel reads.
-        ps._SKIP_YFILL = True
-    else:
-        ps._SKIP_YFILL = False
-    fn = ps.make_pallas_jacobi_multistep(spec, k)
+    # _skip_yfill is an explicit kernel-builder parameter (not module
+    # state, which would silently corrupt kernels built later — ADVICE r3)
+    fn = ps.make_pallas_jacobi_multistep(spec, k, _skip_yfill=patch)
     chunk = 12
 
     def many(a):

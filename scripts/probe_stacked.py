@@ -1,5 +1,12 @@
 """Pure stacked-substep timing (tight-x layout): the DMA-descriptor
-batching result for BASELINE.md. Usage: probe_stacked.py [n]"""
+batching result for BASELINE.md. Usage: probe_stacked.py [n]
+
+NOTE: the stacked kernel variant was REVERTED after the negative result was
+recorded (commit a558ae8: marginal at 256^3, HBM-OOM at 512^3) —
+``make_pallas_substep`` on the current tree has no ``stacked=`` parameter.
+Reproducing the stacked leg requires checking out that commit; here the
+stacked leg is SKIPPED with a notice and only the per-field leg runs
+(ADVICE r3)."""
 import os, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax, jax.numpy as jnp, numpy as np
@@ -19,8 +26,17 @@ spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3).without_x())
 p = spec.padded()
 rng = np.random.RandomState(7)
 chunk = 60 if n <= 256 else 12
+import inspect
+
+HAVE_STACKED = "stacked" in inspect.signature(make_pallas_substep).parameters
 for label, stacked in (("stacked", True), ("per-field", False)):
-    sub = make_pallas_substep(spec, c, inv_ds, 1, 1e-8, stacked=stacked)
+    if stacked and not HAVE_STACKED:
+        print("stacked: SKIPPED — kernel variant reverted (a558ae8); check "
+              "out that commit to reproduce the BASELINE.md negative result",
+              flush=True)
+        continue
+    sub = make_pallas_substep(spec, c, inv_ds, 1, 1e-8,
+                              **({"stacked": True} if stacked else {}))
     if stacked:
         curr = jnp.asarray(rng.rand(NF, p.z, p.y, p.x) * 0.1, jnp.float32)
         out = jnp.asarray(rng.rand(NF, p.z, p.y, p.x) * 0.1, jnp.float32)

@@ -53,13 +53,21 @@ from ..parallel import Method
 from ..utils import logging as log
 from . import bench_exchange, exchange_weak, jacobi3d, measure_overlap
 
-# Single-chip anchors (v5e, round-3 measurements; see BASELINE.md).
-# --record-base overwrites these with freshly measured values. The jacobi
-# anchor is the 256^3-per-chip config-5 configuration itself (fused loop,
-# deep_halo=4), NOT the 512^3 headline, so the efficiency column compares
-# like with like.
+# Single-chip anchors (v5e; see BASELINE.md). --record-base overwrites
+# these with freshly measured values. The jacobi anchor is the
+# 256^3-per-chip config-5 configuration itself (fused loop, deep_halo=4 =>
+# temporal depth PINNED at k=4 on every device count, same as the scaled
+# runs — ADVICE r3), NOT the 512^3 headline, so the efficiency column
+# compares like with like.
+#
+# STALE until re-recorded: 15383.0 was measured in round 3 when the
+# single-block anchor ran the then-unpinned k=10 multistep; under the k=4
+# pin the anchor is slower, so this constant OVERSTATES the anchor (and
+# understates efficiency) until --record-base re-runs on the chip
+# (round-4 TPU session re-records scripts/weak_base.json, which takes
+# precedence over these constants whenever it exists).
 DEFAULT_BASE = {
-    "jacobi_mcells_per_s_per_dev": 15383.0,  # 256^3 deep_halo=4 fused loop
+    "jacobi_mcells_per_s_per_dev": 15383.0,  # 256^3 deep_halo=4 (k=10, stale)
     "exchange_weak_trimean_s": 5.42e-3,      # 512^3 radius-3 4q self-wrap fill
     "config2_trimean_s": 2.00e-3,            # 256^3 radius-2 4q self-wrap fill
 }

@@ -49,9 +49,6 @@ from .jacobi import COLD_TEMP, HOT_TEMP
 # VMEM scratch budget (~16 MB/core on v5e; leave headroom for the compiler)
 _VMEM_BUDGET = 12 * 1024 * 1024
 
-# timing probe only (scripts/probe_noyfill.py): skip the multistep's y-ring
-# fills to size a tight-y layout's payoff; results are WRONG when set
-_SKIP_YFILL = False
 
 
 def _divisors_desc(n: int, cands) -> list:
@@ -354,6 +351,7 @@ def make_pallas_jacobi_multistep(
     k: int,
     interpret: bool = False,
     vma=None,
+    _skip_yfill: bool = False,
 ):
     """Temporal-blocked Jacobi: advance the field ``k`` steps in ONE pass
     over HBM.
@@ -387,7 +385,15 @@ def make_pallas_jacobi_multistep(
     exactly ``d2 < (R+1)^2`` for exact integer d2 (f32 sqrt of an exact
     integer < 2^24 cannot cross an integer boundary), so no sel array is
     read at all.
+
+    ``_skip_yfill`` is a TIMING-PROBE knob (scripts/probe_noyfill.py): it
+    skips the per-stage y-ring fills, so the kernel computes WRONG results.
     """
+    if _skip_yfill:
+        from ..utils import logging as _log
+
+        _log.warn("make_pallas_jacobi_multistep(_skip_yfill=True): "
+                  "TIMING PROBE ONLY — results are WRONG by construction")
     assert spec.aligned
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
@@ -469,7 +475,7 @@ def make_pallas_jacobi_multistep(
             the ring spans the full valid extent so the next stage's
             shifted reads stay within filled cells."""
             xw = slice(xo_k - ex, xo_k + nx + ex)
-            if not my and not _SKIP_YFILL:
+            if not my and not _skip_yfill:
                 ref[slot, yo - 1, xw] = ref[slot, yo + ny - 1, xw]
                 ref[slot, yo + ny, xw] = ref[slot, yo, xw]
             if not mx and not tight_x:
