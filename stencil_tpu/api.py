@@ -50,6 +50,35 @@ from .utils import timer
 from .utils.sync import hard_sync
 
 
+def _stack_residents(dim: Dim3, c: int) -> Dim3:
+    """Mesh dims for stacking ``c`` resident blocks per device onto
+    partition ``dim``: the z-heaviest (cz, cy, cx) factorization of ``c``
+    whose components divide the partition axes (exhaustive — divisor
+    triples of c are few). Reference envelope: dd.set_gpus accepts any
+    block multiset per device (stencil.hpp:154)."""
+    best = None
+    for cz in range(c, 0, -1):
+        if c % cz or dim.z % cz:
+            continue
+        cyx = c // cz
+        for cy in range(cyx, 0, -1):
+            if cyx % cy or dim.y % cy:
+                continue
+            cx = cyx // cy
+            if dim.x % cx:
+                continue
+            best = Dim3(dim.x // cx, dim.y // cy, dim.z // cz)
+            break
+        if best is not None:
+            break
+    if best is None:
+        raise ValueError(
+            f"cannot stack {c} resident blocks per device onto partition "
+            f"{dim}: no divisor triple of {c} divides the axes"
+        )
+    return best
+
+
 class DistributedDomain:
     """A multi-quantity 3D domain distributed over a TPU device mesh."""
 
@@ -131,16 +160,18 @@ class DistributedDomain:
             if dim.flatten() != n:
                 # oversubscription (reference: dd.set_gpus({0,0}),
                 # stencil.hpp:154): run any partition on fewer devices by
-                # stacking c z-blocks per device; the exchange shifts
-                # resident-neighbor slabs locally (exchange.py
-                # _axis_phase_resident)
+                # stacking c = blocks/devices resident blocks per device;
+                # the exchange shifts resident-neighbor slabs locally
+                # (exchange.py _axis_phase_resident). Stacking may mix
+                # axes — prefer z-heavy (the cheapest slab geometry), then
+                # y, then x.
                 c, rem = divmod(dim.flatten(), n)
-                if rem or dim.z % c:
+                if rem:
                     raise ValueError(
-                        f"partition {dim} needs {dim.flatten()} devices (or a "
-                        f"z extent divisible by blocks-per-device), have {n}"
+                        f"partition {dim} has {dim.flatten()} blocks, not a "
+                        f"multiple of {n} devices"
                     )
-                mesh_dim = Dim3(dim.x, dim.y, dim.z // c)
+                mesh_dim = _stack_residents(dim, c)
             self.spec = GridSpec(self.size, dim, self.radius)
             if self._placement is not None and mesh_dim != dim:
                 log.warn(

@@ -109,19 +109,18 @@ class HaloExchange:
         # test_exchange.cu:52): more partition blocks than devices — the
         # extra blocks are RESIDENT: stacked along the block dims of each
         # shard, exchanged by intra-device slab shifts (see
-        # _axis_phase_resident). Supported on the z axis, uniform splits.
-        if (md.x, md.y) != (spec.dim.x, spec.dim.y) or spec.dim.z % md.z:
+        # _axis_phase_resident). Any axis may stack (mixed (cz,cy,cx)
+        # stacking included) and splits may be uneven — per-resident sizes
+        # come from traced lookups into the static per-axis size tables,
+        # the same machinery as the dynamic overlap shells (ops/shells.py).
+        if spec.dim.x % md.x or spec.dim.y % md.y or spec.dim.z % md.z:
             raise ValueError(
-                f"mesh {dict(mesh.shape)} does not match partition {spec.dim}"
+                f"mesh {dict(mesh.shape)} does not divide partition {spec.dim}"
             )
-        self.resident_z = spec.dim.z // md.z
-        if self.resident_z > 1:
-            if len(set(spec.sizes_z)) != 1:
-                raise ValueError(
-                    "oversubscription (blocks > devices) requires a uniform z split"
-                )
-            if method == Method.DIRECT26:
-                raise ValueError("Method.DIRECT26 does not support oversubscription")
+        self.resident = Dim3(
+            spec.dim.x // md.x, spec.dim.y // md.y, spec.dim.z // md.z
+        )
+        self.resident_z = self.resident.z
         if method == Method.DIRECT26 and not spec.is_uniform():
             raise ValueError("Method.DIRECT26 requires a uniform partition")
         for name in (AXIS_X, AXIS_Y, AXIS_Z):
@@ -136,6 +135,11 @@ class HaloExchange:
         self.spec = spec
         self.mesh = mesh
         self.method = method
+
+    @property
+    def oversubscribed(self) -> bool:
+        """More partition blocks than devices on at least one axis."""
+        return self.resident != Dim3(1, 1, 1)
 
     # -- public API ----------------------------------------------------------
     def __call__(self, state):
@@ -153,6 +157,36 @@ class HaloExchange:
             assert axes is None, "axis subsetting requires AXIS_COMPOSED"
             return self._direct26_blocks(block)
         return self._composed_blocks(block, axes)
+
+    def x_side_buffers(self, block, r: int):
+        """Out-of-line x halos for a tight-x layout on a MULTI-BLOCK x axis
+        (``Radius.without_x`` with dim.x > 1): the halo columns that would
+        live inline are delivered as thin side buffers instead. Returns
+        ``(xlo, xhi)``: ``xlo[..., j]`` holds the cell at global
+        ``x = x0 - r + j`` (the -x neighbor's top columns), ``xhi[..., j]``
+        at ``x0 + nx + j``. Per-block, inside ``shard_map``. The kernels
+        roll the interior periodically and the x-edge columns are patched
+        from these buffers — the reference's pack-to-buffer transport
+        economics (src/pack_kernel.cu:3-54) re-expressed: dense side
+        buffers instead of strided inline halo writes."""
+        assert self.spec.radius.x(-1) == 0 and self.spec.radius.x(1) == 0, (
+            "x_side_buffers is the tight-x (zero x radius) transport"
+        )
+        sizes = self.spec.sizes_x
+        assert len(set(sizes)) == 1, "side buffers require a uniform x split"
+        assert self.resident.x == 1, "side buffers do not support x residency"
+        n = len(sizes)
+        nx = sizes[0]
+        hi_cols = block[..., nx - r : nx]
+        lo_cols = block[..., 0:r]
+        if n > 1:
+            fwd = [(i, (i + 1) % n) for i in range(n)]
+            bwd = [(i, (i - 1) % n) for i in range(n)]
+            return (
+                lax.ppermute(hi_cols, AXIS_X, fwd),
+                lax.ppermute(lo_cols, AXIS_X, bwd),
+            )
+        return hi_cols, lo_cols
 
     def exchange_blocks(self, state):
         """Per-block exchange of a whole quantity dict inside ``shard_map``.
@@ -281,8 +315,8 @@ class HaloExchange:
         devs = self.mesh.devices.flatten()
         if not all(d.platform == "tpu" for d in devs):
             return {}
-        if self.resident_z > 1:
-            # resident shards carry a (c,1,1) leading block shape the fill
+        if self.oversubscribed:
+            # resident shards carry a stacked leading block shape the fill
             # kernels' single-block reshape can't represent — XLA slab path
             return {}
         from ..ops.halo_fill import make_self_fill, self_fill_supported
@@ -300,8 +334,10 @@ class HaloExchange:
         sizes, rm, rp, off = _spec_axis(spec, name)
         if rm == 0 and rp == 0:
             return block
-        if name == AXIS_Z and self.resident_z > 1:
-            return self._axis_phase_resident(block, name, adim, self.resident_z)
+        c = {AXIS_Z: self.resident.z, AXIS_Y: self.resident.y,
+             AXIS_X: self.resident.x}[name]
+        if c > 1:
+            return self._axis_phase_resident(block, name, adim, c)
         if (
             len(sizes) == 1
             and block.dtype == jnp.float32
@@ -335,47 +371,65 @@ class HaloExchange:
             block = _update_in_dim(block, slab, off + sz, adim)
         return block
 
+    def _resident_sizes(self, name: str, c: int):
+        """This device's ``c`` resident block sizes along one axis: static
+        ints on a uniform split, traced lookups into the static size table
+        otherwise (global block index = axis_index * c + j — jax shards the
+        leading block dims in contiguous chunks)."""
+        sizes, _rm, _rp, _off = _spec_axis(self.spec, name)
+        if len(set(sizes)) == 1:
+            return [sizes[0]] * c
+        tbl = jnp.asarray(sizes, jnp.int32)
+        idx = lax.axis_index(name)
+        return [tbl[idx * c + j] for j in range(c)]
+
     def _axis_phase_resident(self, block, name: str, adim: int, c: int):
         """Axis phase with ``c`` partition blocks resident per device along
         this axis (oversubscription). Neighbor slabs between resident
         blocks shift along the stacked block dim — a pure local copy, the
         analogue of the reference's same-GPU ``PeerAccessSender``
         short-circuit (tx_cuda.cuh:41-113) — and only the two boundary
-        slabs ride the collective permute."""
+        slabs ride the collective permute. Works on any axis, uneven
+        splits included (per-resident sizes may be traced scalars)."""
         spec = self.spec
         sizes, rm, rp, off = _spec_axis(spec, name)
-        sz = sizes[0]  # uniform (validated in __init__)
         bdim = {AXIS_Z: 0, AXIS_Y: 1, AXIS_X: 2}[name]
-        n_dev = len(sizes) // c
-        fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-        bwd = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+        m = len(sizes) // c
+        fwd = [(i, (i + 1) % m) for i in range(m)]
+        bwd = [(i, (i - 1) % m) for i in range(m)]
+        sz = self._resident_sizes(name, c)
 
-        def take(start, width):
-            s = [slice(None)] * block.ndim
-            s[adim] = slice(start, start + width)
-            return block[tuple(s)]
+        def take_j(j, start, width):
+            starts = _starts(block.ndim, start, adim)
+            starts = starts[:bdim] + (jnp.asarray(j, jnp.int32),) + starts[bdim + 1:]
+            shp = list(block.shape)
+            shp[bdim] = 1
+            shp[adim] = width
+            return lax.dynamic_slice(block, starts, tuple(shp))
+
+        def put_j(b, slab, j, start):
+            starts = _starts(b.ndim, start, adim)
+            starts = starts[:bdim] + (jnp.asarray(j, jnp.int32),) + starts[bdim + 1:]
+            return lax.dynamic_update_slice(b, slab, starts)
 
         if rm > 0:
-            # resident r's top rm planes -> resident r+1's low halo; the
+            # resident j's top rm planes -> resident j+1's low halo; the
             # last resident's slab rides the permute to the next device's
             # resident 0 (fwd: device d receives from d-1)
-            sl = take(off + sz - rm, rm)
-            last = lax.slice_in_dim(sl, c - 1, c, axis=bdim)
-            if n_dev > 1:
-                last = lax.ppermute(last, name, fwd)
-            shifted = jnp.concatenate(
-                [last, lax.slice_in_dim(sl, 0, c - 1, axis=bdim)], axis=bdim
-            )
-            block = _update_in_dim(block, shifted, off - rm, adim)
+            src = [take_j(j, off + sz[j] - rm, rm) for j in range(c)]
+            incoming = src[c - 1]
+            if m > 1:
+                incoming = lax.ppermute(incoming, name, fwd)
+            for j in range(c):
+                block = put_j(block, incoming if j == 0 else src[j - 1], j, off - rm)
         if rp > 0:
-            sl = take(off, rp)
-            first = lax.slice_in_dim(sl, 0, 1, axis=bdim)
-            if n_dev > 1:
-                first = lax.ppermute(first, name, bwd)
-            shifted = jnp.concatenate(
-                [lax.slice_in_dim(sl, 1, c, axis=bdim), first], axis=bdim
-            )
-            block = _update_in_dim(block, shifted, off + sz, adim)
+            src = [take_j(j, off, rp) for j in range(c)]
+            incoming = src[0]
+            if m > 1:
+                incoming = lax.ppermute(incoming, name, bwd)
+            for j in range(c):
+                block = put_j(block, incoming if j == c - 1 else src[j + 1],
+                              j, off + sz[j])
         return block
 
     # -- direct-26 implementation -------------------------------------------
@@ -384,6 +438,7 @@ class HaloExchange:
         sz = spec.base  # uniform
         r = spec.radius
         off = spec.compute_offset()
+        cz, cy, cx = self.resident.z, self.resident.y, self.resident.x
         updates = []
         for d in DIRECTIONS_26:
             if r.dir(-d) == 0:
@@ -414,19 +469,59 @@ class HaloExchange:
                     shape.append(s)
             if any(e == 0 for e in shape):
                 continue
+            # the slab spans every resident block; _roll_blocks routes it to
+            # each block's +d neighbor (local shift + boundary permute)
             slab = lax.dynamic_slice(
                 block,
                 (0, 0, 0) + tuple(starts),
-                (1, 1, 1) + tuple(shape),
+                (cz, cy, cx) + tuple(shape),
             )
-            slab = lax.ppermute(slab, (AXIS_Z, AXIS_Y, AXIS_X), self._perm26(d))
+            slab = self._roll_blocks(slab, d)
             updates.append((slab, dsts))
         for slab, dsts in updates:
             block = lax.dynamic_update_slice(block, slab, (0, 0, 0) + tuple(dsts))
         return block
 
+    def _roll_blocks(self, slab, d: Dim3):
+        """Send each resident block's slab to its ``+d`` neighbor in the
+        GLOBAL block grid: without oversubscription this is the single
+        diagonal 26-neighbor permute; with residents each axis shifts the
+        stacked block dim locally and only the wrap-around boundary rides
+        an axis permute (the per-axis composition of the same move)."""
+        if not self.oversubscribed:
+            return lax.ppermute(slab, (AXIS_Z, AXIS_Y, AXIS_X), self._perm26(d))
+        md = mesh_dim(self.mesh)
+        for name, bdim, comp, m, c in (
+            (AXIS_Z, 0, d.z, md.z, self.resident.z),
+            (AXIS_Y, 1, d.y, md.y, self.resident.y),
+            (AXIS_X, 2, d.x, md.x, self.resident.x),
+        ):
+            if comp == 0:
+                continue
+            if c == 1:
+                if m > 1:
+                    pairs = [(i, (i + comp) % m) for i in range(m)]
+                    slab = lax.ppermute(slab, name, pairs)
+                continue
+            if comp == 1:
+                last = lax.slice_in_dim(slab, c - 1, c, axis=bdim)
+                if m > 1:
+                    last = lax.ppermute(last, name, [(i, (i + 1) % m) for i in range(m)])
+                slab = jnp.concatenate(
+                    [last, lax.slice_in_dim(slab, 0, c - 1, axis=bdim)], axis=bdim
+                )
+            else:
+                first = lax.slice_in_dim(slab, 0, 1, axis=bdim)
+                if m > 1:
+                    first = lax.ppermute(first, name, [(i, (i - 1) % m) for i in range(m)])
+                slab = jnp.concatenate(
+                    [lax.slice_in_dim(slab, 1, c, axis=bdim), first], axis=bdim
+                )
+        return slab
+
     def _perm26(self, d: Dim3) -> Tuple[Tuple[int, int], ...]:
-        """Flattened (z, y, x)-major permutation sending toward ``d``."""
+        """Flattened (z, y, x)-major permutation sending toward ``d``
+        (one block per device — mesh dims == partition dims)."""
         nd = self.spec.dim
         pairs = []
         for iz in range(nd.z):

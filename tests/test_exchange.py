@@ -208,14 +208,137 @@ def test_oversubscribed_exchange_halo_parity():
                         assert blk[z, y, x] == want, (bz, by, bx, z, y, x)
 
 
-def test_oversubscribed_rejects_uneven_z():
+def _coord_field(size):
+    return (
+        np.arange(size.z)[:, None, None] * 1_000_000
+        + np.arange(size.y)[None, :, None] * 1_000
+        + np.arange(size.x)[None, None, :]
+    ).astype(np.float32)
+
+
+def _assert_halos_wrap(arr, spec, size):
+    """Every face-halo cell of every block carries its periodically wrapped
+    source coordinate (spot rows on each face)."""
+    off = spec.compute_offset()
+    r = spec.radius
+    for bz in range(spec.dim.z):
+        for by in range(spec.dim.y):
+            for bx in range(spec.dim.x):
+                blk = arr[bz, by, bx]
+                org = spec.block_origin((bx, by, bz))
+                bs = spec.block_size((bx, by, bz))
+                for z in range(off.z - r.z(-1), off.z + bs.z + r.z(1)):
+                    gz = (org.z + z - off.z) % size.z
+                    for (y, x) in ((off.y - 1, off.x),
+                                   (off.y + bs.y, off.x + bs.x - 1)):
+                        gy = (org.y + y - off.y) % size.y
+                        gx = (org.x + x - off.x) % size.x
+                        want = gz * 1_000_000 + gy * 1_000 + gx
+                        assert blk[z, y, x] == want, (bz, by, bx, z, y, x)
+
+
+def test_oversubscribed_uneven_z_halo_parity():
+    """Uneven split along the RESIDENT axis (z = 7+6): per-resident sizes
+    come from traced size-table lookups; the result must equal the same
+    partition on 8 devices (round-3 rejected this; VERDICT r3 item 4)."""
     import jax
 
     from stencil_tpu.domain.grid import GridSpec
     from stencil_tpu.geometry import Dim3, Radius
     from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks
 
-    spec = GridSpec(Dim3(12, 12, 13), Dim3(2, 2, 2), Radius.constant(1))
-    mesh = grid_mesh(Dim3(2, 2, 1), jax.devices()[:4])
-    with pytest.raises(ValueError, match="uniform z split"):
-        HaloExchange(spec, mesh)
+    size = Dim3(12, 12, 13)
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(2))
+    coord = _coord_field(size)
+    results = {}
+    for label, mesh_dim, ndev in (("over", Dim3(2, 2, 1), 4),
+                                  ("full", Dim3(2, 2, 2), 8)):
+        mesh = grid_mesh(mesh_dim, jax.devices()[:ndev])
+        ex = HaloExchange(spec, mesh)
+        state = ex({0: shard_blocks(coord, spec, mesh)})
+        results[label] = np.asarray(jax.device_get(state[0]))
+    np.testing.assert_array_equal(results["over"], results["full"])
+    _assert_halos_wrap(results["over"], spec, size)
+
+
+def test_oversubscribed_uneven_multidevice_axis_halo_parity():
+    """Uneven split (z = 4+4+3+3) with the resident axis spanning MULTIPLE
+    devices (4 z-blocks, 2 residents on each of 2 devices): exercises the
+    axis_index*c+j size-table lookup at axis_index > 0, which the
+    single-device-axis tests never reach."""
+    import jax
+
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks
+
+    size = Dim3(12, 12, 14)
+    spec = GridSpec(size, Dim3(1, 1, 4), Radius.constant(2))
+    assert tuple(spec.sizes_z) == (4, 4, 3, 3)
+    coord = _coord_field(size)
+    results = {}
+    for label, mesh_dim, ndev in (("over", Dim3(1, 1, 2), 2),
+                                  ("full", Dim3(1, 1, 4), 4)):
+        mesh = grid_mesh(mesh_dim, jax.devices()[:ndev])
+        ex = HaloExchange(spec, mesh)
+        state = ex({0: shard_blocks(coord, spec, mesh)})
+        results[label] = np.asarray(jax.device_get(state[0]))
+    np.testing.assert_array_equal(results["over"], results["full"])
+    _assert_halos_wrap(results["over"], spec, size)
+
+
+def test_oversubscribed_mixed_axes_halo_parity():
+    """(cz, cy) = (2, 2) mixed stacking — a 2x2x2 partition on TWO devices
+    (mesh 1x1x2 on x) — and pure-y stacking on 4: both must equal the fully
+    distributed 8-device exchange (VERDICT r3 item 4)."""
+    import jax
+
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks
+
+    size = Dim3(12, 12, 12)
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(2))
+    coord = _coord_field(size)
+    results = {}
+    for label, mesh_dim, ndev in (("mixed2", Dim3(2, 1, 1), 2),
+                                  ("ystack", Dim3(2, 1, 2), 4),
+                                  ("full", Dim3(2, 2, 2), 8)):
+        mesh = grid_mesh(mesh_dim, jax.devices()[:ndev])
+        ex = HaloExchange(spec, mesh)
+        assert ex.oversubscribed == (label != "full")
+        state = ex({0: shard_blocks(coord, spec, mesh)})
+        results[label] = np.asarray(jax.device_get(state[0]))
+    np.testing.assert_array_equal(results["mixed2"], results["full"])
+    np.testing.assert_array_equal(results["ystack"], results["full"])
+    _assert_halos_wrap(results["mixed2"], spec, size)
+
+
+def test_oversubscribed_direct26_halo_parity():
+    """DIRECT26 under oversubscription (exclusion lifted, VERDICT r3
+    item 4): resident rolls + boundary permutes must match the fully
+    distributed DIRECT26 exchange, on z-stacked AND mixed meshes."""
+    import jax
+
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks
+
+    size = Dim3(12, 12, 12)
+    spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(2))
+    coord = _coord_field(size)
+    results = {}
+    for label, mesh_dim, ndev in (("zstack", Dim3(2, 2, 1), 4),
+                                  ("mixed2", Dim3(2, 1, 1), 2),
+                                  ("full", Dim3(2, 2, 2), 8)):
+        mesh = grid_mesh(mesh_dim, jax.devices()[:ndev])
+        ex = HaloExchange(spec, mesh, method=Method.DIRECT26)
+        state = ex({0: shard_blocks(coord, spec, mesh)})
+        results[label] = np.asarray(jax.device_get(state[0]))
+    np.testing.assert_array_equal(results["zstack"], results["full"])
+    np.testing.assert_array_equal(results["mixed2"], results["full"])
+    _assert_halos_wrap(results["mixed2"], spec, size)
