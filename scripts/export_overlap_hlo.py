@@ -50,6 +50,24 @@ def jacobi_export(overlap: bool) -> str:
     return jax.export.export(step, platforms=["tpu"])(curr, nxt, sel).mlir_module()
 
 
+def jacobi_sidebuf_export() -> str:
+    """Multi-block tight-x (out-of-line side buffers, VERDICT r3 item 5):
+    dim 2x2x1, zero x radius — the full sweep must stay independent of the
+    y permutes AND the x side-buffer permutes."""
+    from stencil_tpu.ops.jacobi import make_jacobi_step, sphere_sel
+
+    size = Dim3(256, 16, 12)
+    spec = GridSpec(size, Dim3(2, 2, 1), Radius.constant(1).without_x())
+    mesh = grid_mesh(spec.dim, jax.devices()[:4])
+    ex = HaloExchange(spec, mesh)
+    step = make_jacobi_step(ex, overlap=True, use_pallas=True, interpret=False)
+    z = np.zeros((size.z, size.y, size.x), np.float32)
+    curr = shard_blocks(z, spec, mesh)
+    nxt = shard_blocks(z, spec, mesh)
+    sel = shard_blocks(sphere_sel(size), spec, mesh)
+    return jax.export.export(step, platforms=["tpu"])(curr, nxt, sel).mlir_module()
+
+
 def astaroth_export() -> str:
     from stencil_tpu.astaroth import config as ac_config
     from stencil_tpu.astaroth.integrate import FIELDS, make_astaroth_step
@@ -80,6 +98,8 @@ def main(which: str) -> int:
         txt = jacobi_export(True)
     elif which == "jacobi-serial":
         txt = jacobi_export(False)
+    elif which == "jacobi-sidebuf":
+        txt = jacobi_sidebuf_export()
     elif which == "astaroth-overlap":
         txt = astaroth_export()
     else:

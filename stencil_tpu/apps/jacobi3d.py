@@ -72,14 +72,22 @@ def run(
     # deep_halo > 1 realizes radius-k halos so the fused loop can take the
     # communication-avoiding multistep on multi-block meshes (one radius-k
     # exchange per k steps); the workload stays radius-1 jacobi
-    if (n == 1 and size.x % 128 == 0
-            and (partition is None or Dim3.of(partition) == Dim3(1, 1, 1))
+    pdim = None
+    if partition is not None:
+        pdim = Dim3.of(partition)
+    elif n == 1:
+        pdim = Dim3(1, 1, 1)
+    if (pdim is not None and pdim.x == 1 and pdim.flatten() == n
+            and size.x % 128 == 0
+            and size.y % pdim.y == 0 and size.z % pdim.z == 0
             and all(d.platform == "tpu" for d in devices)):
-        # tight-x layout: a single chip wraps x in-kernel (lane rolls), so
-        # no x halo columns are allocated — every slab DMA sheds the
-        # px/nx lane padding (1.36x at 512^3, BASELINE.md round 3). A
-        # partition override (oversubscription ablation) keeps inline
-        # halos: the zero-x-radius layout requires a single block.
+        # tight-x layout: a single-BLOCK x axis wraps x in-kernel (lane
+        # rolls), so no x halo columns are allocated — every slab DMA
+        # sheds the px/nx lane padding (1.36x at 512^3, BASELINE.md round
+        # 3). Multi-block y/z axes keep their inline halos and exchange
+        # normally; their overlap shells take the roll-aware sweep. An
+        # x-split, uneven, or oversubscribed partition keeps inline halos
+        # everywhere (the Pallas fast path disengages there).
         from ..geometry import Radius
 
         dd.set_radius(Radius.constant(deep_halo).without_x())
@@ -111,8 +119,14 @@ def run(
 
     def get_loop(k: int):
         if k not in loops:
+            # an explicit deep_halo pins the temporal depth at k=deep_halo on
+            # EVERY device count — a single-block run would otherwise take
+            # k=10 (no radius bound) and poison weak-scaling efficiency
+            # columns against radius-capped N-chip runs (ADVICE r3)
+            tk = deep_halo if deep_halo >= 2 else None
             loops[k] = (
-                make_jacobi_loop(dd.halo_exchange, k, overlap=overlap)
+                make_jacobi_loop(dd.halo_exchange, k, overlap=overlap,
+                                 temporal_k=tk)
                 if k > 1
                 else make_jacobi_step(dd.halo_exchange, overlap=overlap)
             )

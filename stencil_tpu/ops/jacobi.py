@@ -64,6 +64,58 @@ def jacobi_sweep(src, out, rect: Rect3, masks=None):
     return out.at[(..., *_rect_slices(rect))].set(avg.astype(out.dtype))
 
 
+def _sweep_shell_wrap_x(src, out, rect: Rect3, masks=None):
+    """:func:`jacobi_sweep` for a shell rect spanning the FULL x extent of
+    a tight-x block (``Radius.without_x``: no x halo columns exist, the x
+    axis is single-block periodic): the x neighborhood comes from rolls.
+    Operand order matches the Pallas kernel's (x_lo + x_hi + y + z) so
+    overlap-patched cells are bit-identical to serialized ones."""
+    c = src[(..., *_rect_slices(rect))]
+    avg = (
+        jnp.roll(c, 1, -1)
+        + jnp.roll(c, -1, -1)
+        + src[(..., *_rect_slices(rect, dy=-1))]
+        + src[(..., *_rect_slices(rect, dy=1))]
+        + src[(..., *_rect_slices(rect, dz=-1))]
+        + src[(..., *_rect_slices(rect, dz=1))]
+    ) / 6
+    if masks is not None:
+        hot, cold = masks
+        sl = (..., *_rect_slices(rect))
+        avg = jnp.where(hot[sl], HOT_TEMP, jnp.where(cold[sl], COLD_TEMP, avg))
+    return out.at[(..., *_rect_slices(rect))].set(avg.astype(out.dtype))
+
+
+def _patch_x_edges_sidebuf(src, out, compute: Rect3, xlo, xhi, masks=None):
+    """Recompute the two x-edge columns of the compute region from
+    exchanged side buffers (multi-block tight-x: the kernel's lane rolls
+    wrapped onto the block's OWN columns, wrong at block edges). Operand
+    order matches the kernel's x_lo + x_hi + y + z sum for bit parity."""
+    lo, hi = compute.lo, compute.hi
+    zy = (slice(lo.z, hi.z), slice(lo.y, hi.y))
+
+    def col(x0, dz=0, dy=0):
+        return src[(..., slice(lo.z + dz, hi.z + dz),
+                    slice(lo.y + dy, hi.y + dy), slice(x0, x0 + 1))]
+
+    for edge, x_lo, x_hi in (
+        (lo.x, xlo[(..., *zy, slice(-1, None))], col(lo.x + 1)),
+        (hi.x - 1, col(hi.x - 2), xhi[(..., *zy, slice(0, 1))]),
+    ):
+        avg = (
+            x_lo + x_hi
+            + col(edge, dy=-1) + col(edge, dy=1)
+            + col(edge, dz=-1) + col(edge, dz=1)
+        ) / 6
+        dst = (..., *zy, slice(edge, edge + 1))
+        if masks is not None:
+            hot, cold = masks
+            avg = jnp.where(hot[dst], HOT_TEMP,
+                            jnp.where(cold[dst], COLD_TEMP, avg))
+        out = out.at[dst].set(avg.astype(out.dtype))
+    return out
+
+
 def _sweep_slab_dyn(src3, o3, sel3, lo, size):
     """Re-sweep one dynamic-offset boundary shell ``[lo, lo + size)`` of a
     (pz, py, px) block from exchanged data ``src3`` into ``o3``. ``size`` is
@@ -137,7 +189,8 @@ def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None,
 
 
 def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pallas=None,
-                     standard_spheres: bool = True, interpret: bool = False):
+                     standard_spheres: bool = True, interpret: bool = False,
+                     temporal_k: Optional[int] = None):
     """Like :func:`make_jacobi_step` but runs ``iters`` iterations inside one
     compiled program (``lax.fori_loop``) — one host dispatch per chunk.
 
@@ -151,9 +204,16 @@ def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pal
     then may the temporal-blocked kernel engage, because it re-derives the
     spheres from coordinates instead of reading ``sel``. Pass ``False``
     when driving the step with a custom or empty ``sel``.
+
+    ``temporal_k`` caps the temporal-blocking depth explicitly. Weak-scaling
+    comparisons need it: a single-block mesh has no radius bound and would
+    run k=10 while an N-chip deep-halo run is capped at the realized radius,
+    conflating temporal depth with scaling in the efficiency column
+    (ADVICE r3).
     """
     return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas,
-                           standard_spheres=standard_spheres, interpret=interpret)
+                           standard_spheres=standard_spheres, interpret=interpret,
+                           temporal_k=temporal_k)
 
 
 def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
@@ -162,23 +222,37 @@ def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
     devs = ex.mesh.devices.flatten()
     # resident (oversubscribed) blocks carry a stacked leading dim the
     # fused kernels don't handle — XLA path there
-    return (ex.spec.aligned and ex.resident_z == 1
+    return (ex.spec.aligned and not ex.oversubscribed
             and all(d.platform == "tpu" for d in devs))
 
 
 def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
-                    standard_spheres: bool = True, interpret: bool = False):
+                    standard_spheres: bool = True, interpret: bool = False,
+                    temporal_k: Optional[int] = None):
     spec = ex.spec
     r = spec.radius
     assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
         "jacobi needs face radius >= 1 on every side"
     )
-    if min(r.x(-1), r.x(1)) < 1:
-        # zero-x-radius tight layout (Radius.without_x): no x halo columns
-        # exist; only the Pallas kernels can form the x neighborhood
-        # (lane rolls), and only on a single-block x axis
-        assert spec.dim == Dim3(1, 1, 1) and spec.base.x % 128 == 0, (
-            "zero x radius requires a single block and a lane-aligned x extent"
+    tight_x = min(r.x(-1), r.x(1)) < 1
+    # tight-x on a MULTI-BLOCK x axis: kernels still roll x block-locally
+    # (wrong at block edges) and the exchange delivers the true neighbor
+    # columns as side buffers, from which the two x-edge columns are
+    # patched (VERDICT r3 item 5; reference pack-to-buffer economics,
+    # src/pack_kernel.cu:3-54)
+    side_x = tight_x and spec.dim.x > 1
+    if tight_x:
+        # zero-x-radius layout (Radius.without_x): no x halo columns exist;
+        # only the Pallas kernels can form the x neighborhood (lane rolls).
+        # Single-block x wraps periodically in-kernel; multi-block x takes
+        # side buffers. Multi-block y/z overlap shells span the full x
+        # extent and take the roll-aware sweep (_sweep_shell_wrap_x).
+        assert spec.base.x % 128 == 0, (
+            "zero x radius requires lane-aligned per-block x extents"
+        )
+        assert spec.is_uniform(), (
+            "tight-x with multi-block axes requires uniform splits (dynamic "
+            "shells keep inline halos)"
         )
         assert _want_pallas(ex, use_pallas), (
             "zero x radius requires the Pallas fast path (in-kernel x wrap)"
@@ -189,8 +263,12 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     exteriors = exterior_regions(compute, interior)
     use_overlap = overlap and spec.is_uniform()
     # uneven partitions overlap too — via dynamic-offset shells instead of
-    # static exterior rects (per-block extents are static per block index)
-    use_dyn_overlap = overlap and not spec.is_uniform()
+    # static exterior rects (per-block extents are static per block index).
+    # Resident (oversubscribed) shards carry a stacked leading block dim the
+    # shell machinery's (pz,py,px) reshape cannot express — those fall back
+    # to the serialized exchange-then-sweep path instead of crashing at
+    # trace time (ADVICE r3).
+    use_dyn_overlap = overlap and not spec.is_uniform() and not ex.oversubscribed
 
     pallas_sweep = None
     pallas_axes = None
@@ -206,11 +284,19 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
         from ..parallel.exchange import Method
 
         if ex.method == Method.AXIS_COMPOSED:
-            wrap = (spec.dim.z == 1, spec.dim.y == 1, spec.dim.x == 1)
+            # side_x: the kernel rolls x block-locally exactly like a
+            # self-wrap axis; the block-edge columns are patched from the
+            # exchanged side buffers afterwards
+            wrap = (spec.dim.z == 1, spec.dim.y == 1,
+                    spec.dim.x == 1 or side_x)
             pallas_axes = tuple(
                 name for name, w in zip((AXIS_Z, AXIS_Y, AXIS_X), wrap) if not w
             )
         else:
+            assert not side_x, (
+                "multi-block tight-x requires Method.AXIS_COMPOSED "
+                "(side buffers compose with axis phases)"
+            )
             wrap = (False, False, False)
             pallas_axes = None  # DIRECT26 has no axis phases to subset
         # interpret mode (CI integration tests): the pallas HLO interpreter
@@ -257,6 +343,28 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
             if pallas_axes is None:  # DIRECT26: no axis phases to subset
                 cur2 = ex.exchange_block(curr)
                 return sweep3(cur2, nxt), cur2
+            if side_x:
+                # multi-block x without inline halos: the kernel's x rolls
+                # wrap onto the block's own columns; the exchange delivers
+                # the true neighbor columns as side buffers and the two
+                # edge columns are re-swept from them (after any y/z
+                # shells, so edge cells inside shells are also correct)
+                masks = (sel == 1, sel == 2)
+                if use_overlap:
+                    out = sweep3(curr, nxt)
+                    cur2 = ex.exchange_block(curr)
+                    xlo, xhi = ex.x_side_buffers(curr, 1)
+                    for rect in pallas_shells:
+                        out = _sweep_shell_wrap_x(cur2, out, rect, masks)
+                else:
+                    # FULL exchange (self-wrap fills included): the edge
+                    # patch reads y/z halo rows of the edge columns, which
+                    # the axis-subset exchange would leave stale
+                    cur2 = ex.exchange_block(curr)
+                    xlo, xhi = ex.x_side_buffers(cur2, 1)
+                    out = sweep3(cur2, nxt)
+                out = _patch_x_edges_sidebuf(cur2, out, compute, xlo, xhi, masks)
+                return out, cur2
             if not pallas_axes:  # every axis self-wraps: no exchange at all
                 return sweep3(curr, nxt), curr
             if use_overlap:
@@ -271,8 +379,9 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
                 out = sweep3(curr, nxt)
                 cur2 = ex.exchange_block(curr)
                 masks = (sel == 1, sel == 2)
+                shell_sweep = _sweep_shell_wrap_x if tight_x else jacobi_sweep
                 for rect in pallas_shells:
-                    out = jacobi_sweep(cur2, out, rect, masks)
+                    out = shell_sweep(cur2, out, rect, masks)
                 return out, cur2
             if use_dyn_overlap:
                 # same structure, uneven partition: the kernel still wraps
@@ -321,13 +430,18 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     multistep = None
     deep_halo = False
     TEMPORAL_K = 0
-    if (pallas_sweep is not None and pallas_axes is not None
+    # side_x is excluded: its empty/partial pallas_axes would read as
+    # "self-wrap" to the multistep, whose in-kernel x wrap is wrong at
+    # block edges (deep-halo x needs radius >= k, which tight-x lacks)
+    if (pallas_sweep is not None and pallas_axes is not None and not side_x
             and standard_spheres and iters and spec.is_uniform()):
         p = spec.padded()
         plane = p.y * p.x * 4
         budget = 46 * 1024 * 1024  # measured compile ceiling minus headroom
         k_mem = (budget // plane - 6) // 3 + 1
         k_cap = max(0, min(10, (spec.base.z - 1) // 2, iters, k_mem))
+        if temporal_k is not None:
+            k_cap = min(k_cap, temporal_k)
         if pallas_axes:
             # multi-block: the fused multistep subsumes the overlap
             # structure, so it only engages when overlap was requested —
