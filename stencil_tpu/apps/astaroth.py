@@ -84,13 +84,17 @@ def run(
     number of timed iterations the state advanced."""
     devices = list(devices) if devices is not None else jax.devices()
     if (overlap and np.dtype(dtype) == np.float64
-            and all(d.platform == "tpu" for d in devices)):
-        # fp64 on TPU: the serialized step compiles in ~2 min; the
-        # interior/exterior overlap structure (7 integrate regions per
-        # substep x f64 emulation expansion) blows past a 25-minute
-        # compile budget (BASELINE.md round 3, scripts/probe_f64*.py)
-        log.info("fp64 on TPU: forcing overlap=False (overlap structure "
-                 "explodes compile time under f64 emulation)")
+            and all(d.platform == "tpu" for d in devices)
+            and os.environ.get("STENCIL_F64_OVERLAP") != "1"):
+        # fp64 on TPU: the serialized step compiles in ~2 min. The round-3
+        # per-substep overlap structure (7 integrate regions x 3 substeps
+        # x f64 emulation expansion) blew a 25-minute compile budget; the
+        # round-4 hoisted-exchange overlap iteration is 9 bodies and is
+        # expected to compile — set STENCIL_F64_OVERLAP=1 to take it
+        # (default stays serialized until the chip record lands,
+        # BASELINE.md round 4, scripts/probe_f64*.py)
+        log.info("fp64 on TPU: forcing overlap=False (set "
+                 "STENCIL_F64_OVERLAP=1 for the hoisted overlap structure)")
         overlap = False
     info, ok = load_config(conf)
     if not ok:
@@ -111,18 +115,20 @@ def run(
 
     dd = DistributedDomain(size.x, size.y, size.z)
     radius = Radius.constant(3)
-    if len(devices) == 1 and use_pallas is not False:
-        # tight-x layout on one chip: no x halo columns (kernel forms the
-        # periodic x pencils with lane rolls) — sheds the px/nx DMA lane
-        # padding AND the x self-fill's lane-tile RMW entirely. Engage only
+    if d3.x == 1 and use_pallas is not False:
+        # tight-x layout on a single-block x axis (any y/z mesh): no x halo
+        # columns (kernel forms the periodic x pencils with lane rolls) —
+        # sheds the px/nx DMA lane padding AND the x self-fill's lane-tile
+        # RMW entirely; multi-block y/z halos ride the exchange and their
+        # overlap shells take the x-wrapped slab integrate. Engage only
         # when the fused kernel supports the resulting layout.
         from ..domain.grid import GridSpec
         from ..ops.pallas_astaroth import substep_supported
 
         tight = radius.without_x()
-        tight_spec = GridSpec(size, Dim3(1, 1, 1), tight)
+        tight_spec = GridSpec(size, d3, tight)
         if (np.dtype(dtype) == np.float32
-                and devices[0].platform == "tpu"
+                and all(d.platform == "tpu" for d in devices)
                 and substep_supported(tight_spec, jnp.float32)):
             radius = tight
     dd.set_radius(radius)

@@ -147,6 +147,39 @@ def _integrate_region_dyn(spec, substep, lo, size, inv_ds, c, dt, curr, out,
     return res
 
 
+def _integrate_shell_wrap_x(substep, rect, inv_ds, c, dt, curr, out):
+    """:func:`_integrate_region` for a shell rect spanning the FULL x
+    extent of a tight-x block (``Radius.without_x``: no x halo columns, the
+    x axis is single-block periodic): a thin x-wrapped slab is materialized
+    for the shell's z/y reach and the same region math runs over it. Shells
+    are r-thick faces, so the extended slab is small."""
+    h = _H
+    zsl = slice(rect.lo.z - h, rect.hi.z + h)
+    ysl = slice(rect.lo.y - h, rect.hi.y + h)
+
+    def ext(a):
+        sl = a[(..., zsl, ysl, slice(None))]
+        return jnp.concatenate([sl[..., -h:], sl, sl[..., :h]], axis=-1)
+
+    curr_s = {k: ext(v) for k, v in curr.items()}
+    out_s = {k: ext(v) for k, v in out.items()}
+    dz = rect.hi.z - rect.lo.z
+    dy = rect.hi.y - rect.lo.y
+    nx = rect.hi.x - rect.lo.x
+    rect_s = Rect3(Dim3(h, h, h), Dim3(h + nx, h + dy, h + dz))
+    new_s = _integrate_region(substep, rect_s, inv_ds, c, dt, curr_s, out_s)
+    res = {}
+    core = (..., slice(h, h + dz), slice(h, h + dy), slice(h, h + nx))
+    dst = (..., slice(rect.lo.z, rect.hi.z), slice(rect.lo.y, rect.hi.y),
+           slice(rect.lo.x, rect.hi.x))
+    for k in FIELDS:
+        res[k] = out[k].at[dst].set(new_s[k][core].astype(out[k].dtype))
+    return res
+
+
+_H = 3  # 6th-order stencil reach (reference: astaroth.h STENCIL_ORDER 6)
+
+
 def uses_pallas(ex: HaloExchange, use_pallas, dtype="float32") -> bool:
     """Whether :func:`make_astaroth_step` will take the fused Pallas path
     for fields of ``dtype`` (None = auto: TPU, fp32, aligned blocks, no
@@ -161,7 +194,7 @@ def uses_pallas(ex: HaloExchange, use_pallas, dtype="float32") -> bool:
     devs = ex.mesh.devices.flatten()
     return (
         all(d.platform == "tpu" for d in devs)
-        and ex.resident_z == 1
+        and not ex.oversubscribed
         and substep_supported(ex.spec, jnp.dtype(dtype))
     )
 
@@ -200,13 +233,19 @@ def make_astaroth_step(
         "astaroth needs face radius >= 3 (6th-order stencils)"
     )
     pallas_on = uses_pallas(ex, use_pallas, dtype)
-    if min(r.x(-1), r.x(1)) < 3:
+    tight_x = min(r.x(-1), r.x(1)) < 3
+    if tight_x:
         # zero-x-radius tight layout (Radius.without_x): no x halo columns;
         # only the fused kernel can form the periodic x pencils (lane
-        # rolls), and only on a single block
-        assert r.x(-1) == 0 and r.x(1) == 0 and spec.dim == Dim3(1, 1, 1), (
+        # rolls), and only on a single-BLOCK x axis — y/z may have any
+        # number of blocks (their overlap shells integrate over x-wrapped
+        # slabs, _integrate_shell_wrap_x)
+        assert r.x(-1) == 0 and r.x(1) == 0 and spec.dim.x == 1, (
             "x radius must be 3+ (inline halos) or exactly 0 (tight layout, "
-            "single block)"
+            "single-block x axis)"
+        )
+        assert spec.is_uniform(), (
+            "tight-x with multi-block y/z requires uniform splits"
         )
         assert pallas_on, "tight-x astaroth requires the fused Pallas path"
     inv_ds = (
@@ -223,8 +262,11 @@ def make_astaroth_step(
     # uneven partitions keep the overlap structure via per-block dynamic
     # geometry (ops/shells.py): masked interior write + dynamic-offset
     # shells, the analogue of the reference's per-LocalDomain regions
-    # (src/stencil.cu:878-977)
-    use_dyn_overlap = overlap and not spec.is_uniform()
+    # (src/stencil.cu:878-977). Resident (oversubscribed) shards carry a
+    # stacked leading block dim the shell machinery's (pz,py,px) reshape
+    # cannot express — serialized exchange-then-sweep instead of a
+    # trace-time crash (ADVICE r3).
+    use_dyn_overlap = overlap and not spec.is_uniform() and not ex.oversubscribed
 
     def _dyn_geometry():
         from ..ops.shells import dyn_block_sizes, interior_mask, shell_regions
@@ -290,7 +332,12 @@ def make_astaroth_step(
                 out = untuple(kernels[0](to3(curr), to3(out)), out)
                 curr = exchange_all(curr)
                 for rect in exteriors:
-                    out = _integrate_region(0, rect, inv_ds, c, dt, curr, out)
+                    if tight_x:
+                        out = _integrate_shell_wrap_x(
+                            0, rect, inv_ds, c, dt, curr, out
+                        )
+                    else:
+                        out = _integrate_region(0, rect, inv_ds, c, dt, curr, out)
             elif use_dyn_overlap:
                 # uneven partition: same structure, shells at per-block
                 # dynamic offsets (substep 0 never reads out, so the full
@@ -310,6 +357,27 @@ def make_astaroth_step(
             return out, curr  # one swap per iteration (astaroth.cu:642-648)
 
     else:
+        def hoisted_overlap_iteration(curr, out):
+            """Reference swap-per-iteration mode, XLA path: the SAME
+            hoisted-exchange dataflow the Pallas iteration uses. Substep 0
+            integrates the full region from PRE-exchange data (never reads
+            out, so re-integrating boundary shells from the exchanged
+            state afterwards is exact); the iteration's single exchange is
+            free to fly concurrently; substeps 1-2 read post-exchange
+            data. 9 integrate bodies per iteration instead of the
+            per-substep structure's 21 — which is also what makes
+            fp64-on-TPU OVERLAP compile: the round-3 bounded negative
+            (32^3 fp64 overlap > 25 min compile, scripts/probe_f64_overlap
+            .py) was the 7-region x 3-substep op-graph under f64's ~10x
+            emulation expansion, not fp64 itself."""
+            out = _integrate_region(0, compute, inv_ds, c, dt, curr, out)
+            curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+            for rect in exteriors:
+                out = _integrate_region(0, rect, inv_ds, c, dt, curr, out)
+            for s in (1, 2):
+                out = _integrate_region(s, compute, inv_ds, c, dt, curr, out)
+            return out, curr  # one swap per iteration (astaroth.cu:642-648)
+
         def substep_block(substep, curr, out):
             if use_overlap:
                 out = _integrate_region(substep, interior, inv_ds, c, dt, curr, out)
@@ -337,6 +405,8 @@ def make_astaroth_step(
             return curr, out
 
         def iteration(curr, out):
+            if use_overlap and not swap_per_substep:
+                return hoisted_overlap_iteration(curr, out)
             for substep in range(3):
                 curr, out = substep_block(substep, curr, out)
                 if swap_per_substep:
