@@ -1,0 +1,159 @@
+"""Probe: y-direction FD taps as banded MATMULS on the MXU vs shifted
+slice sums on the VPU (task: astaroth 512^3 arithmetic is the recorded
+floor binder — tap arithmetic runs on the VPU at ~2.1 Tflop/s while the
+MXU idles; a 6th-order y-derivative over a (rows_in -> ty) window is
+exactly a banded [ty, rows_in] matmul, contraction along sublanes).
+
+Two kernels over the substep's (tz, rows_in, px) window shape:
+- vpu: dy and d2y of NF fields by shifted sublane slices + weighted sums
+  (the production fd.py structure);
+- mxu: the same 2*NF pencils as one [2*ty, rows_in] x [rows_in, px]
+  dot_general per field-plane (bf16x3 fp32 passes on the MXU), no sublane
+  realignment at all.
+
+Outputs are cross-checked (rtol 1e-5: matmul reassociates the 7-term sum)
+and both are timed per substep-equivalent tile count at 512^3.
+
+Usage: python scripts/probe_mxu_taps.py [n]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from stencil_tpu.astaroth.fd import FIRST_COEFFS, SECOND_CENTER, SECOND_COEFFS
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.ops.pallas_astaroth import NF, pick_tiles
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+H = 3
+
+
+def _interp():
+    return jax.devices()[0].platform != "tpu"
+
+
+def band_matrix(ty: int, rows_in: int, yo: int, inv: float) -> np.ndarray:
+    """[2*ty, rows_in] banded operator: rows 0..ty-1 produce dy, rows
+    ty..2ty-1 produce d2y, for output rows yo..yo+ty-1 of the window."""
+    M = np.zeros((2 * ty, rows_in), np.float32)
+    for j in range(ty):
+        r = yo + j
+        for i, cc in enumerate(FIRST_COEFFS, start=1):
+            M[j, r + i] += cc * inv
+            M[j, r - i] -= cc * inv
+        M[ty + j, r] += SECOND_CENTER * inv * inv
+        for i, cc in enumerate(SECOND_COEFFS, start=1):
+            M[ty + j, r + i] += cc * inv * inv
+            M[ty + j, r - i] += cc * inv * inv
+    return M
+
+
+def main():
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3).without_x())
+    tz, ty = pick_tiles(spec)
+    px = spec.padded().x
+    rows_in = ty + 16
+    yo = 8
+    inv = 1.7
+    n_tiles = (spec.base.z // tz) * (spec.base.y // ty)
+    c1 = [float(c) for c in FIRST_COEFFS]
+    c2 = [float(c) for c in SECOND_COEFFS]
+
+    def vpu_kernel(win_ref, out_ref):
+        for f in range(NF):
+            for z in range(tz):
+                w = win_ref[f, z]
+                dy = jnp.zeros((ty, px), jnp.float32)
+                d2 = jnp.full((ty, px), 0.0, jnp.float32) + (
+                    float(SECOND_CENTER) * inv * inv
+                ) * w[yo : yo + ty, :]
+                for i in range(1, 4):
+                    hi = w[yo + i : yo + ty + i, :]
+                    lo = w[yo - i : yo + ty - i, :]
+                    dy = dy + (c1[i - 1] * inv) * (hi - lo)
+                    d2 = d2 + (c2[i - 1] * inv * inv) * (hi + lo)
+                out_ref[f, z, 0] = dy
+                out_ref[f, z, 1] = d2
+
+    M_np = band_matrix(ty, rows_in, yo, inv)
+
+    def mxu_kernel(win_ref, m_ref, out_ref):
+        m = m_ref[...]
+        for f in range(NF):
+            for z in range(tz):
+                w = win_ref[f, z]
+                both = jax.lax.dot_general(
+                    m, w, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                out_ref[f, z, 0] = both[0:ty, :]
+                out_ref[f, z, 1] = both[ty : 2 * ty, :]
+
+    win_shape = (NF, tz, rows_in, px)
+    out_shape = jax.ShapeDtypeStruct((NF, tz, 2, ty, px), jnp.float32)
+    vpu = pl.pallas_call(
+        vpu_kernel,
+        grid=(n_tiles,),
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=_interp(),
+    )
+    mxu = pl.pallas_call(
+        mxu_kernel,
+        grid=(n_tiles,),
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=_interp(),
+    )
+    rng = np.random.RandomState(11)
+    win = jnp.asarray(rng.rand(*win_shape) * 0.1, jnp.float32)
+    M = jnp.asarray(M_np)
+
+    a = np.asarray(jax.jit(vpu)(win))
+    b = np.asarray(jax.jit(mxu)(win, M))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+    print(f"parity ok: vpu vs mxu pencils agree (tz,ty)=({tz},{ty}), "
+          f"{n_tiles} tiles", flush=True)
+
+    chunk = 8
+    for label, g in (
+        ("vpu", jax.jit(lambda w: jax.lax.fori_loop(
+            0, chunk, lambda _, o: vpu(w), vpu(w)))),
+        ("mxu", jax.jit(lambda w: jax.lax.fori_loop(
+            0, chunk, lambda _, o: mxu(w, M), mxu(w, M)))),
+    ):
+        t0 = time.time()
+        out = g(win)
+        hard_sync(out)
+        cs = time.time() - t0
+        st = Statistics()
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = g(win)
+            hard_sync(out)
+            st.insert((time.perf_counter() - t0) / chunk)
+        print(f"{label}: {st.trimean()*1e3:.3f} ms per substep-equivalent "
+              f"({NF} fields x {tz} planes x (dy+d2y) x {n_tiles} tiles; "
+              f"compile {cs:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,199 @@
+"""Correct-math microbenchmarks for the substep window-shift and the
+multistep y-ring fills at 512^3 shapes (VERDICT r3 item 2: the round-3
+floor accounting leaned on wrong-results probes of the production kernels;
+these standalone kernels measure the SAME VMEM operations in isolation and
+verify their outputs, so the numbers carry no corrupted-kernel caveat).
+
+- window-shift: per tile, the astaroth substep copies NF x 2H halo planes
+  down the sliding window (`win[f, 0:2H] = win[f, tz:tz+2H]`). The
+  microbenchmark kernel performs exactly those copies per grid step over
+  the 512^3 tile schedule, then drains a checksum plane so the stores are
+  live; the output is verified against the expected roll of the seeded
+  window.
+- y-ring: the jacobi multistep copies 2 rows per stage per grid step
+  (`ref[slot, yo-1, :] = ref[slot, yo+ny-1, :]`); same treatment at k=10.
+
+Usage: python scripts/probe_vmem_ops.py [n]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.ops.pallas_astaroth import NF, pick_tiles
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+H = 3
+# CPU smoke (logic validation at tiny n): interpret mode off-TPU
+INTERP = None  # resolved after backend selection in main
+
+
+def _interp():
+    import jax
+    return jax.devices()[0].platform != "tpu"
+
+
+def window_shift_bench():
+    """The substep's per-tile window shift, alone, on the 512^3 schedule."""
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3).without_x())
+    tz, ty = pick_tiles(spec)
+    px = spec.padded().x
+    rows_in = ty + 16
+    W = tz + 2 * H
+    n_tiles = (spec.base.z // tz) * (spec.base.y // ty)
+    shifts_per_call = n_tiles  # the substep shifts on every non-strip-start
+    # tile; we shift on every tile (upper bound by < (1 + n_strips/n_tiles))
+
+    def kernel(seed_ref, out_ref, win, s):
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _():
+            cp = pltpu.make_async_copy(seed_ref, win.at[0], s)
+            cp.start()
+            cp.wait()
+
+        for f in range(NF):
+            win[f, 0 : 2 * H] = win[f, tz : tz + 2 * H]
+
+        @pl.when(t == n_tiles - 1)
+        def _():
+            cp = pltpu.make_async_copy(win.at[0, pl.ds(0, 1)], out_ref, s)
+            cp.start()
+            cp.wait()
+
+    fn = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        out_shape=jax.ShapeDtypeStruct((1, rows_in, px), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((NF, W, rows_in, px), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), has_side_effects=True
+        ),
+        interpret=_interp(),
+    )
+    rng = np.random.RandomState(3)
+    seed = jnp.asarray(rng.rand(W, rows_in, px), jnp.float32)
+    chunk = 8
+    g = jax.jit(lambda s0: jax.lax.fori_loop(
+        0, chunk, lambda _, o: fn(s0), fn(s0)))
+    t0 = time.time()
+    out = g(seed)
+    hard_sync(out)
+    cs = time.time() - t0
+    # correctness: verify plane 0 against a numpy emulation of the same
+    # n_tiles-long overlapping-copy sequence
+    w = np.array(seed)
+    for _ in range(n_tiles):
+        w[0 : 2 * H] = w[tz : tz + 2 * H]
+    np.testing.assert_allclose(np.asarray(out)[0], w[0], rtol=0, atol=0)
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = g(seed)
+        hard_sync(out)
+        st.insert((time.perf_counter() - t0) / chunk)
+    per_call = st.trimean()
+    print(
+        f"window-shift {n}^3 (tz,ty)=({tz},{ty}): {per_call*1e3:.3f} ms per "
+        f"substep-equivalent ({shifts_per_call} shifts of {NF}x{2*H} planes "
+        f"x {rows_in}x{px}; compile {cs:.0f}s)",
+        flush=True,
+    )
+
+
+def y_ring_bench():
+    """The multistep's per-stage y-ring row copies, alone, at k=10."""
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(1).without_x())
+    from stencil_tpu.ops.pallas_stencil import _pick_tiles
+
+    p = spec.padded()
+    off = spec.compute_offset()
+    tz, ty = _pick_tiles(spec.base.z, spec.base.y, off.y, p.y, p.x)
+    k = 10
+    px = p.x
+    rows = ty + 16 if ty != spec.base.y else p.y
+    yo = 8 if ty != spec.base.y else off.y
+    ny = ty
+    n_tiles = (spec.base.z // tz) * (spec.base.y // ty)
+    copies = 2 * k  # per grid step in the k=10 multistep
+
+    def kernel(seed_ref, out_ref, buf, s):
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _():
+            cp = pltpu.make_async_copy(seed_ref, buf, s)
+            cp.start()
+            cp.wait()
+
+        for _ in range(k):
+            buf[0, yo - 1, :] = buf[0, yo + ny - 1, :]
+            buf[0, yo + ny, :] = buf[0, yo, :]
+
+        @pl.when(t == n_tiles - 1)
+        def _():
+            cp = pltpu.make_async_copy(buf, out_ref, s)
+            cp.start()
+            cp.wait()
+
+    fn = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        out_shape=jax.ShapeDtypeStruct((tz + 2, rows, px), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((tz + 2, rows, px), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), has_side_effects=True
+        ),
+        interpret=_interp(),
+    )
+    rng = np.random.RandomState(5)
+    seed = jnp.asarray(rng.rand(tz + 2, rows, px), jnp.float32)
+    chunk = 8
+    g = jax.jit(lambda s0: jax.lax.fori_loop(
+        0, chunk, lambda _, o: fn(s0), fn(s0)))
+    t0 = time.time()
+    out = g(seed)
+    hard_sync(out)
+    cs = time.time() - t0
+    w = np.array(seed)
+    w[0, yo - 1, :] = w[0, yo + ny - 1, :]
+    w[0, yo + ny, :] = w[0, yo, :]  # fixpoint after the first pair
+    np.testing.assert_allclose(np.asarray(out), w, rtol=0, atol=0)
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = g(seed)
+        hard_sync(out)
+        st.insert((time.perf_counter() - t0) / chunk)
+    print(
+        f"y-ring {n}^3 (tz,ty)=({tz},{ty}) k={k}: {st.trimean()*1e3:.3f} ms "
+        f"per multistep call ({copies} row copies x {n_tiles} tiles of "
+        f"{px} lanes; compile {cs:.0f}s)",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    window_shift_bench()
+    y_ring_bench()

@@ -4,6 +4,7 @@ over the virtual 8-device mesh."""
 import sys
 
 import jax
+import pytest
 import numpy as np
 
 
@@ -23,11 +24,13 @@ def test_entry_single_chip():
     assert np.isfinite(np.asarray(jax.device_get(curr))).all()
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     ge = _load()
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_4():
     ge = _load()
     ge.dryrun_multichip(4)

@@ -74,6 +74,7 @@ def test_substep_parity(substep, tiles):
         assert not np.array_equal(got[k][sl], np.asarray(curr[k])[sl])
 
 
+@pytest.mark.slow
 def test_distributed_pallas_step_matches_xla_path():
     """Full distributed step (exchange + fused substeps inside shard_map)
     on a 2x2x2 mesh in interpret mode vs the XLA path — pins the
@@ -127,6 +128,7 @@ def test_substep_gates():
     assert not substep_supported(odd, jnp.float32)
 
 
+@pytest.mark.slow
 def test_pick_tiles_budget():
     spec, *_ = _setup((256, 256, 256))
     tz, ty = pick_tiles(spec)
