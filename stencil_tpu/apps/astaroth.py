@@ -105,8 +105,16 @@ def run(
         info.int_params["AC_nz"] = nx
         info.update_builtin_params()
 
-    # weak scaling: base extent x decompose_zyx(#devices)
-    d3 = decompose_zyx(len(devices))
+    # weak scaling: base extent x device decomposition. On TPU the split
+    # stays in z/y (geometry.decompose_zy): every chip keeps the tight-x
+    # layout, no minor-dim slab slicing, 2D ICI mesh — the reference's
+    # 3-axis decompose_zyx (astaroth.cu:263-276) remains for CPU.
+    if len(devices) > 1 and all(d.platform == "tpu" for d in devices):
+        from ..geometry import decompose_zy
+
+        d3 = decompose_zy(len(devices))
+    else:
+        d3 = decompose_zyx(len(devices))
     size = Dim3(
         info.int_params["AC_nx"] * d3.x,
         info.int_params["AC_ny"] * d3.y,

@@ -66,7 +66,21 @@ def run(
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
-    size = weak_scale(x, y, z, n) if weak else Dim3(x, y, z)
+    if (weak and n > 1 and partition is None
+            and x % 128 == 0
+            and all(d.platform == "tpu" for d in devices)):
+        # TPU-first weak scaling: grow + split over z/y only
+        # (geometry.decompose_zy) so every chip keeps the tight-x layout
+        # and the mesh is a 2D ICI-friendly z x y grid; the reference's
+        # smallest-axis weak_scale + 3-axis partition stays for CPU and
+        # explicit partitions
+        from ..geometry import decompose_zy
+
+        d3 = decompose_zy(n)
+        size = Dim3(x, y * d3.y, z * d3.z)
+        partition = d3
+    else:
+        size = weak_scale(x, y, z, n) if weak else Dim3(x, y, z)
 
     dd = DistributedDomain(size.x, size.y, size.z)
     # deep_halo > 1 realizes radius-k halos so the fused loop can take the

@@ -22,6 +22,28 @@ from .numeric import div_ceil, prime_factors
 from .radius import Radius
 
 
+def decompose_zy(p: int) -> Dim3:
+    """TPU-first device decomposition: split over z and y ONLY, keeping
+    the lane (x) axis whole.
+
+    Three wins over the reference's 3-axis decomposition
+    (astaroth.cu:263-276) on TPU hardware: (1) every chip keeps the
+    tight-x layout — no x halo columns, periodic x via lane rolls
+    (1.36-1.62x measured per chip, BASELINE.md round 3); (2) the exchange
+    never slices the minor dim, so no slab pays (8,128) lane-tile
+    amplification; (3) splitting two axes moves fewer halo bytes for the
+    same shard volume (4 split faces instead of 6) and the 2D z x y mesh
+    maps directly onto the v5e ICI torus. z grows first (matches the
+    slowest-varying layout dim)."""
+    y = z = 1
+    for pf in prime_factors(max(p, 1)):
+        if z <= y:
+            z *= pf
+        else:
+            y *= pf
+    return Dim3(1, y, z)
+
+
 class RankPartition:
     """Split ``size`` into ``n`` subdomains along the longest axes.
 

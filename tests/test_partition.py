@@ -109,3 +109,17 @@ def test_node_partition_uneven():
     assert sizes == [4, 3, 3]
     assert origins == [0, 4, 7]
     assert not part.is_uniform()
+
+
+def test_decompose_zy_keeps_x_whole():
+    """TPU-first decomposition: z/y only, z first, x never splits."""
+    from stencil_tpu.geometry import decompose_zy
+
+    assert tuple(decompose_zy(1)) == (1, 1, 1)
+    assert tuple(decompose_zy(2)) == (1, 1, 2)
+    assert tuple(decompose_zy(4)) == (1, 2, 2)
+    assert tuple(decompose_zy(8)) == (1, 2, 4)
+    assert tuple(decompose_zy(64)) == (1, 8, 8)
+    for p in (3, 6, 12, 24, 48):
+        d = decompose_zy(p)
+        assert d.x == 1 and d.flatten() == p
