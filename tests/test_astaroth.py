@@ -764,6 +764,26 @@ def test_tight_x_multiblock_yz_matches_reference():
                                    err_msg=k)
 
 
+def test_tight_x_rejects_multiblock_x():
+    """Documented envelope: the tight-x astaroth substep requires a
+    single-BLOCK x axis (an x-split would need r=3 side buffers with
+    edge-halo composition; the TPU decomposition never splits x —
+    geometry.decompose_zy). The gate must reject loudly, not miscompute."""
+    info = ac_config.AcMeshInfo()
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = 256
+    info.int_params["AC_ny"] = info.int_params["AC_nz"] = 16
+    info.update_builtin_params()
+    spec = GridSpec(Dim3(256, 16, 16), Dim3(2, 1, 1),
+                    Radius.constant(3).without_x())
+    mesh = grid_mesh(spec.dim, jax.devices()[:2])
+    ex = HaloExchange(spec, mesh)
+    with pytest.raises(AssertionError, match="single-block x axis"):
+        make_astaroth_step(ex, info, dt=1e-3, dtype="float32",
+                           use_pallas=True, interpret=True)
+
+
 @pytest.mark.slow
 def test_tight_x_layout_matches_inline_reference():
     """Radius.without_x on a single block (px == nx, x pencils via lane

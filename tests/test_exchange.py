@@ -17,7 +17,7 @@ import pytest
 from stencil_tpu.domain.grid import GridSpec
 from stencil_tpu.geometry import DIRECTIONS_26, Dim3, Radius
 from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
-from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
+from stencil_tpu.parallel.exchange import BLOCK_PSPEC, shard_blocks, unshard_blocks
 
 
 def coord_field(g: Dim3) -> np.ndarray:
@@ -287,6 +287,46 @@ def test_oversubscribed_uneven_multidevice_axis_halo_parity():
         results[label] = np.asarray(jax.device_get(state[0]))
     np.testing.assert_array_equal(results["over"], results["full"])
     _assert_halos_wrap(results["over"], spec, size)
+
+
+def test_x_side_buffers_carry_neighbor_columns():
+    """Tight-x multi-block transport: x_side_buffers must deliver the -x
+    neighbor's top r columns as xlo and the +x neighbor's first r columns
+    as xhi, periodically wrapped, for r=1 and r=2."""
+    size = Dim3(256, 8, 6)  # two 128-wide x blocks
+    spec = GridSpec(size, Dim3(2, 1, 1), Radius.constant(1).without_x())
+    mesh = grid_mesh(spec.dim, jax.devices()[:2])
+    ex = HaloExchange(spec, mesh)
+    coord = _coord_field(size)
+    state = shard_blocks(coord, spec, mesh)
+
+    for r in (1, 2):
+        fn = jax.jit(jax.shard_map(
+            lambda b: ex.x_side_buffers(b, r),
+            mesh=mesh, in_specs=BLOCK_PSPEC,
+            out_specs=(BLOCK_PSPEC, BLOCK_PSPEC),
+        ))
+        xlo, xhi = fn(state)
+        xlo = np.asarray(jax.device_get(xlo))
+        xhi = np.asarray(jax.device_get(xhi))
+        off = spec.compute_offset()
+        for bx in range(2):
+            org = spec.block_origin((bx, 0, 0))
+            blk_lo = xlo[0, 0, bx]
+            blk_hi = xhi[0, 0, bx]
+            for j in range(r):
+                # xlo[..., j] = global x = org.x - r + j (wrapped)
+                gx = (org.x - r + j) % size.x
+                np.testing.assert_array_equal(
+                    blk_lo[off.z, off.y, j],
+                    coord[0, 0, gx], err_msg=f"xlo r={r} bx={bx} j={j}",
+                )
+                # xhi[..., j] = global x = org.x + nx + j (wrapped)
+                gx = (org.x + spec.sizes_x[bx] + j) % size.x
+                np.testing.assert_array_equal(
+                    blk_hi[off.z, off.y, j],
+                    coord[0, 0, gx], err_msg=f"xhi r={r} bx={bx} j={j}",
+                )
 
 
 def test_oversubscribed_mixed_axes_halo_parity():
