@@ -24,6 +24,10 @@ from stencil_tpu.parallel.exchange import shard_blocks
 from stencil_tpu.utils.sync import hard_sync
 
 sizes = [int(s) for s in sys.argv[1:]] or [16, 32, 64]
+# STENCIL_PROBE_F64_OVERLAP=1: build the round-4 hoisted-exchange overlap
+# iteration (9 integrate bodies) instead of the serialized step — the
+# fp64+overlap compile experiment (VERDICT r3 item 3)
+OV = os.environ.get("STENCIL_PROBE_F64_OVERLAP") == "1"
 print("devices:", jax.devices(), flush=True)
 
 for n in sizes:
@@ -40,7 +44,7 @@ for n in sizes:
     fields = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
     fields["lnrho"] = fields["lnrho"] + 0.5
     try:
-        step = make_astaroth_step(ex, info, dt=1e-8, overlap=False,
+        step = make_astaroth_step(ex, info, dt=1e-8, overlap=OV,
                                   use_pallas=False, dtype="float64")
         curr = {k: shard_blocks(fields[k], spec, mesh, dtype=np.float64)
                 for k in FIELDS}
@@ -56,8 +60,8 @@ for n in sizes:
         hard_sync(curr)
         run_ms = (time.perf_counter() - t0) / 3 * 1e3
         finite = bool(np.isfinite(np.asarray(jax.device_get(curr["lnrho"]))).all())
-        print(f"f64 {n}^3 XLA-path: compile {compile_s:.0f}s, "
+        print(f"f64 {n}^3 XLA-path overlap={OV}: compile {compile_s:.0f}s, "
               f"{run_ms:.1f} ms/iter, finite={finite}", flush=True)
     except Exception as e:  # noqa: BLE001
-        print(f"f64 {n}^3 XLA-path: FAIL {type(e).__name__}: {str(e)[:300]}",
+        print(f"f64 {n}^3 XLA-path overlap={OV}: FAIL {type(e).__name__}: {str(e)[:300]}",
               flush=True)

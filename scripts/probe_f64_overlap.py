@@ -1,38 +1,23 @@
-"""Is the interior/exterior overlap structure the fp64 compile-time
-explosion? (round-2 negative result said 32^3 fp64 didn't compile in 25
-min; the plain serialized path compiles in ~2 min)."""
-import os, sys, time
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import jax
-jax.config.update("jax_enable_x64", True)
-import numpy as np
-from stencil_tpu.astaroth import config as ac_config
-from stencil_tpu.astaroth.integrate import FIELDS, make_astaroth_step
-from stencil_tpu.apps.astaroth import DEFAULT_CONF
-from stencil_tpu.domain.grid import GridSpec
-from stencil_tpu.geometry import Dim3, Radius
-from stencil_tpu.parallel import HaloExchange, grid_mesh
-from stencil_tpu.parallel.exchange import shard_blocks
-from stencil_tpu.utils.sync import hard_sync
+"""fp64 + overlap compile experiment — thin wrapper.
 
-n = 32
-info = ac_config.AcMeshInfo()
-with open(DEFAULT_CONF) as f:
-    ac_config.parse_config(f.read(), info)
-info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
-info.update_builtin_params()
-spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
-mesh = grid_mesh(spec.dim, jax.devices()[:1])
-ex = HaloExchange(spec, mesh)
-rng = np.random.RandomState(0)
-fields = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
-fields["lnrho"] = fields["lnrho"] + 0.5
-step = make_astaroth_step(ex, info, dt=1e-8, overlap=True,
-                          use_pallas=False, dtype="float64")
-curr = {k: shard_blocks(fields[k], spec, mesh, dtype=np.float64) for k in FIELDS}
-nxt = {k: shard_blocks(np.zeros((n, n, n)), spec, mesh, dtype=np.float64)
-       for k in FIELDS}
-t0 = time.time()
-curr, nxt = step(curr, nxt)
-hard_sync(curr)
-print(f"f64 {n}^3 overlap=True: compile+run {time.time()-t0:.0f}s", flush=True)
+Round 3 this script built the per-substep interior/exterior overlap
+structure at 32^3 and recorded the bounded negative (compile > 25 min:
+7 regions x 3 substeps x ~10x f64 emulation expansion). Round 4 replaced
+that structure with the hoisted-exchange overlap iteration (9 integrate
+bodies — astaroth/integrate.py hoisted_overlap_iteration), and the
+experiment lives in probe_f64.py behind STENCIL_PROBE_F64_OVERLAP=1.
+This wrapper just sets the flag so the historical entry point keeps
+working:
+
+    python scripts/probe_f64_overlap.py [sizes...]
+"""
+import os
+import runpy
+import sys
+
+os.environ["STENCIL_PROBE_F64_OVERLAP"] = "1"
+sys.argv = [sys.argv[0]] + (sys.argv[1:] or ["32"])
+runpy.run_path(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "probe_f64.py"),
+    run_name="__main__",
+)
