@@ -359,33 +359,51 @@ class DistributedDomain:
 
     def write_paraview(self, prefix: str, zero_nans: bool = False) -> None:
         """Per-block CSV dump of the interior — same columns as the reference
-        (Z,Y,X,<quantity names>; reference: src/stencil.cu:1188-1264)."""
+        (Z,Y,X,<quantity names>; reference: src/stencil.cu:1188-1264).
+
+        Rows stream from the native writer (native/paraview.cpp — the
+        reference's writer is C++ too, and a Python row loop is minutes of
+        interpreter time at flagship sizes); the pure-Python loop is the
+        byte-identical fallback when the shared library is unavailable."""
         off = self.spec.compute_offset()
         hosts = {
             idx: np.asarray(jax.device_get(arr)) for idx, arr in self._curr.items()
         }
+        try:
+            from .native import paraview_write
+        except Exception:
+            paraview_write = None
         for i in range(self.spec.num_blocks()):
             idx3 = self._block_idx(i)
             sz = self.spec.block_size(idx3)
             origin = self.spec.block_origin(idx3)
             path = f"{prefix}_{i}.txt"
-            with open(path, "w") as f:
-                cols = ["Z", "Y", "X"] + list(self._names)
-                f.write(",".join(cols) + "\n")
-                qs = []
-                for qi in range(len(self._names)):
-                    block = hosts[qi][idx3.z, idx3.y, idx3.x]
-                    q = block[
-                        off.z : off.z + sz.z, off.y : off.y + sz.y, off.x : off.x + sz.x
-                    ]
-                    if zero_nans:
-                        q = np.nan_to_num(q, nan=0.0)
-                    qs.append(q)
-                for lz in range(sz.z):
-                    for ly in range(sz.y):
-                        for lx in range(sz.x):
-                            pos = origin + Dim3(lx, ly, lz)
-                            row = [str(pos.z), str(pos.y), str(pos.x)]
-                            row += [repr(float(q[lz, ly, lx])) for q in qs]
-                            f.write(",".join(row) + "\n")
+            header = ",".join(["Z", "Y", "X"] + list(self._names))
+            qs = []
+            for qi in range(len(self._names)):
+                block = hosts[qi][idx3.z, idx3.y, idx3.x]
+                q = block[
+                    off.z : off.z + sz.z, off.y : off.y + sz.y, off.x : off.x + sz.x
+                ]
+                if zero_nans:
+                    q = np.nan_to_num(q, nan=0.0)
+                qs.append(q)
+            if paraview_write is not None:
+                try:
+                    paraview_write(
+                        path, header,
+                        (origin.z, origin.y, origin.x), (sz.z, sz.y, sz.x), qs,
+                    )
+                except OSError:  # stale .so without the symbol: fall back
+                    paraview_write = None
+            if paraview_write is None:
+                with open(path, "w") as f:
+                    f.write(header + "\n")
+                    for lz in range(sz.z):
+                        for ly in range(sz.y):
+                            for lx in range(sz.x):
+                                pos = origin + Dim3(lx, ly, lz)
+                                row = [str(pos.z), str(pos.y), str(pos.x)]
+                                row += [repr(float(q[lz, ly, lx])) for q in qs]
+                                f.write(",".join(row) + "\n")
             log.info(f"wrote paraview file {path}")

@@ -43,10 +43,45 @@ def _load() -> ctypes.CDLL:
     lib.stencil_qap_solve.restype = ctypes.c_int
     lib.stencil_qap_solve_catch.argtypes = [ctypes.c_int, dp, dp, sp, dp]
     lib.stencil_qap_solve_catch.restype = ctypes.c_int
+    # optional symbol: a stale prebuilt .so (no compiler to rebuild) must
+    # not take down the QAP entry points with it
+    pw = getattr(lib, "stencil_paraview_write", None)
+    if pw is not None:
+        pw.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.POINTER(dp),
+        ]
+        pw.restype = ctypes.c_int
     return lib
 
 
 _LIB = _load()
+
+
+def paraview_write(path: str, header: str, origin, size, qs) -> None:
+    """Stream one block's CSV rows (Z,Y,X,q0,...) from C++.
+
+    ``origin``/``size`` are (z, y, x) tuples; ``qs`` is a list of dense
+    [sz, sy, sx] float64 arrays. Emits byte-identical output to the
+    Python fallback (shortest-round-trip floats, Python-repr rules)."""
+    if getattr(_LIB, "stencil_paraview_write", None) is None:
+        raise OSError(
+            "libstencil_native.so predates the paraview writer; "
+            "rebuild with `make -C native`"
+        )
+    arrs = [np.ascontiguousarray(q, dtype=np.float64) for q in qs]
+    dp = ctypes.POINTER(ctypes.c_double)
+    ptrs = (dp * len(arrs))(*[a.ctypes.data_as(dp) for a in arrs])
+    rc = _LIB.stencil_paraview_write(
+        path.encode(), header.encode(),
+        int(origin[0]), int(origin[1]), int(origin[2]),
+        int(size[0]), int(size[1]), int(size[2]),
+        len(arrs), ptrs,
+    )
+    if rc != 0:
+        raise OSError(f"stencil_paraview_write({path!r}) failed rc={rc}")
 
 
 class qap_native:
