@@ -47,8 +47,12 @@ step 6 f64_overlap 3600 env STENCIL_PROBE_F64_OVERLAP=1 python scripts/probe_f64
 # 6. weak-scaling single-chip anchors at the pinned temporal depth k=4
 step 7 record_base 2700 python -m stencil_tpu.apps.weak_scaling --record-base
 
-# 7. the full bench (green-artifact rehearsal: headline + exchange +
+# 7. config-2 geometry fully resident on the one chip: the first REAL
+#    multi-block exchange + jacobi numbers (previously virtual-CPU only)
+step 8 resident_exchange 1800 python scripts/probe_resident_exchange.py
+
+# 8. the full bench (green-artifact rehearsal: headline + exchange +
 #    astaroth 256 + budget-gated astaroth 512)
-step 8 bench 1500 env STENCIL_BENCH_BUDGET_S=1200 python bench.py
+step 9 bench 1500 env STENCIL_BENCH_BUDGET_S=1200 python bench.py
 
 echo "=== session done ($(date +%H:%M:%S))" | tee -a "$LOG/session.log"
