@@ -6,53 +6,55 @@
 #
 # Usage: bash scripts/r04_measure.sh [start_step]
 cd "$(dirname "$0")/.." || exit 1
-LOG=scripts/r04_logs
+LOG=${MEASURE_LOG_DIR:-scripts/r04_logs}
 mkdir -p "$LOG"
 START=${1:-1}
 
+FAILED=0
 step() {
   local num=$1 name=$2 budget=$3
   shift 3
   if [ "$num" -lt "$START" ]; then return; fi
   echo "=== step $num $name ($(date +%H:%M:%S), budget ${budget}s)" | tee -a "$LOG/session.log"
   timeout "$budget" "$@" > "$LOG/$name.log" 2>&1
-  echo "=== step $num $name rc=$? ($(date +%H:%M:%S))" | tee -a "$LOG/session.log"
+  local rc=$?
+  [ "$rc" -ne 0 ] && FAILED=$((FAILED + 1))
+  echo "=== step $num $name rc=$rc ($(date +%H:%M:%S))" | tee -a "$LOG/session.log"
 }
 
-# 0. alive gate: ALWAYS probed (even when resuming mid-queue) — do not
-# burn budgets against a wedged tunnel or trust a stale alive.log
-timeout 300 python -c "
-import jax, jax.numpy as jnp
-print(jax.devices())
-x = jnp.ones((256,256)); print('alive', float((x@x).sum()))" > "$LOG/alive.log" 2>&1
+# step 1 (implicit) — alive gate: ALWAYS probed (even when resuming
+# mid-queue) — do not burn budgets against a wedged tunnel or trust a
+# stale alive.log
+timeout 300 python scripts/tpu_alive_probe.py > "$LOG/alive.log" 2>&1
 grep -q "^alive" "$LOG/alive.log" || { echo "TPU not alive; aborting" | tee -a "$LOG/session.log"; exit 1; }
 echo "=== alive gate passed ($(date +%H:%M:%S))" | tee -a "$LOG/session.log"
 
-# 1. 512^3 substep autotune table (VERDICT item 2)
+# 2. 512^3 substep autotune table (VERDICT item 2)
 step 2 tiles512 2700 python scripts/probe_tiles512.py
 
-# 2. correct-math microbenchmarks: window-shift + y-ring at 512^3
+# 3. correct-math microbenchmarks: window-shift + y-ring at 512^3
 step 3 vmem_ops 1800 python scripts/probe_vmem_ops.py 512
 
-# 3. MXU banded-matmul taps vs VPU slices at 512^3 shapes
+# 4. MXU banded-matmul taps vs VPU slices at 512^3 shapes
 step 4 mxu_taps 1800 python scripts/probe_mxu_taps.py 512
 
-# 4. fp64 astaroth at the reference's own 256^3 config (serialized path)
+# 5. fp64 astaroth at the reference's own 256^3 config (serialized path)
 step 5 f64_256 3600 python scripts/probe_f64.py 256
 
-# 5. fp64 + hoisted-exchange overlap (round-4 structure): compile budget
+# 6. fp64 + hoisted-exchange overlap (round-4 structure): compile budget
 #    2x the serialized path's; 32^3 then 64^3
 step 6 f64_overlap 3600 env STENCIL_PROBE_F64_OVERLAP=1 python scripts/probe_f64.py 32 64
 
-# 6. weak-scaling single-chip anchors at the pinned temporal depth k=4
+# 7. weak-scaling single-chip anchors at the pinned temporal depth k=4
 step 7 record_base 2700 python -m stencil_tpu.apps.weak_scaling --record-base
 
-# 7. config-2 geometry fully resident on the one chip: the first REAL
+# 8. config-2 geometry fully resident on the one chip: the first REAL
 #    multi-block exchange + jacobi numbers (previously virtual-CPU only)
 step 8 resident_exchange 1800 python scripts/probe_resident_exchange.py
 
-# 8. the full bench (green-artifact rehearsal: headline + exchange +
+# 9. the full bench (green-artifact rehearsal: headline + exchange +
 #    astaroth 256 + budget-gated astaroth 512)
 step 9 bench 1500 env STENCIL_BENCH_BUDGET_S=1200 python bench.py
 
-echo "=== session done ($(date +%H:%M:%S))" | tee -a "$LOG/session.log"
+echo "=== session done, failed_steps=$FAILED ($(date +%H:%M:%S))" | tee -a "$LOG/session.log"
+exit "$FAILED"

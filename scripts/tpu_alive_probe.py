@@ -1,0 +1,13 @@
+"""Single shared TPU alive probe (used by r05_watch.sh and r04_measure.sh).
+
+Prints the device list and an ``alive <sum>`` line on success; any hang is
+the caller's problem (wrap in ``timeout``). Kept as one file so the watcher
+and the measurement queue's alive gate can never drift apart.
+"""
+
+import jax
+import jax.numpy as jnp
+
+print(jax.devices())
+x = jnp.ones((256, 256))
+print("alive", float((x @ x).sum()))
