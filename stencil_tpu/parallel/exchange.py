@@ -202,7 +202,7 @@ class HaloExchange:
             return jax.tree.map(self.exchange_block, state)
         from ..ops.halo_fill import max_fill_group
 
-        p = self.spec.padded()
+        fshape = self._fill_shape()
         gmax = max_fill_group(self.spec)
         fused = [k for k in state if state[k].dtype == jnp.float32]
         rest = [k for k in state if k not in fused]
@@ -218,7 +218,7 @@ class HaloExchange:
                 for i in range(0, len(fused), ax_gmax):
                     chunk = fused[i : i + ax_gmax]
                     fill = self._multi_fill(name, len(chunk))
-                    res = fill(*[out[k].reshape(p.z, p.y, p.x) for k in chunk])
+                    res = fill(*[out[k].reshape(fshape) for k in chunk])
                     res = (res,) if len(chunk) == 1 else res
                     for k, v in zip(chunk, res):
                         out[k] = v.reshape(state[k].shape)
@@ -239,7 +239,8 @@ class HaloExchange:
                 from .mesh import MESH_AXES
 
                 cache[(axis, nq)] = make_self_fill(
-                    self.spec, axis, vma=MESH_AXES, nq=nq
+                    self.spec, axis, vma=MESH_AXES, nq=nq,
+                    z_stack=self.resident.z,
                 )
         return cache[(axis, nq)]
 
@@ -311,13 +312,19 @@ class HaloExchange:
     def _self_fills(self):
         """axis name -> in-place Pallas halo-fill kernel, for single-block
         (self-wrap) axes on TPU (the pack/unpack-kernel analogue; see
-        ops/halo_fill.py). Empty off-TPU or for unsupported layouts."""
+        ops/halo_fill.py). Empty off-TPU or for unsupported layouts.
+
+        Pure z-stack residency ((cz, 1, 1) oversubscription) keeps the
+        fills: the x/y kernels act within each z plane, so the stacked
+        shard viewed as one (cz*pz, py, px) array is filled by ONE kernel
+        (VERDICT r4 item 7 — the reference's same-GPU fast path also runs
+        under oversubscription, tx_cuda.cuh:41-113). Mixed x/y residency
+        stacks non-z block dims the contiguous reshape can't express —
+        those keep the XLA slab path."""
         devs = self.mesh.devices.flatten()
         if not all(d.platform == "tpu" for d in devs):
             return {}
-        if self.oversubscribed:
-            # resident shards carry a stacked leading block shape the fill
-            # kernels' single-block reshape can't represent — XLA slab path
+        if self.resident.x != 1 or self.resident.y != 1:
             return {}
         from ..ops.halo_fill import make_self_fill, self_fill_supported
         from .mesh import MESH_AXES
@@ -325,9 +332,19 @@ class HaloExchange:
         fills = {}
         for name in (AXIS_X, AXIS_Y, AXIS_Z):
             sizes, _rm, _rp, _o = _spec_axis(self.spec, name)
-            if len(sizes) == 1 and self_fill_supported(self.spec, name, jnp.float32):
-                fills[name] = make_self_fill(self.spec, name, vma=MESH_AXES)
+            if len(sizes) == 1 and self_fill_supported(
+                self.spec, name, jnp.float32, z_stack=self.resident.z
+            ):
+                fills[name] = make_self_fill(
+                    self.spec, name, vma=MESH_AXES, z_stack=self.resident.z
+                )
         return fills
+
+    def _fill_shape(self) -> Tuple[int, int, int]:
+        """The contiguous 3-d view a self-fill kernel runs over: the padded
+        block, with any resident z-stack folded into the leading dim."""
+        p = self.spec.padded()
+        return (self.resident.z * p.z, p.y, p.x)
 
     def _axis_phase(self, block, name: str, adim: int):
         spec = self.spec
@@ -345,10 +362,9 @@ class HaloExchange:
         ):
             # self-wrap axis: fill halos in place, touching only the edge
             # tiles, instead of materializing slabs + whole-array updates
-            p = spec.padded()
-            return self._self_fills[name](block.reshape(p.z, p.y, p.x)).reshape(
-                block.shape
-            )
+            return self._self_fills[name](
+                block.reshape(self._fill_shape())
+            ).reshape(block.shape)
         n = len(sizes)
         uniform = len(set(sizes)) == 1
         if uniform:
