@@ -1,0 +1,135 @@
+"""Export production Pallas kernels for the TPU platform and print their
+static DMA-traffic inventory (stencil_tpu.utils.mosaic_traffic) as JSON.
+
+Run as a subprocess by tests/test_traffic_accounting.py (jax.export's deep
+lowering recursion is incompatible with pytest's rewritten frames — same
+trick as export_overlap_hlo.py); also usable standalone:
+
+    python scripts/export_traffic.py multistep 4
+    python scripts/export_traffic.py substep
+    python scripts/export_traffic.py fill-x
+
+Prints one JSON line: {"kernels": [KernelTraffic.report(), ...], ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.utils.mosaic_traffic import capture_traffic
+
+
+def multistep(k: int) -> dict:
+    """Temporal-blocked jacobi at a single-block 256x128x32: the 1/k-HBM
+    claim (BASELINE.md; ops/pallas_stencil.make_pallas_jacobi_multistep)."""
+    from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_multistep
+
+    spec = GridSpec(Dim3(256, 128, 32), Dim3(1, 1, 1), Radius.constant(1))
+    p = spec.padded()
+
+    def build():
+        fn = make_pallas_jacobi_multistep(spec, k)
+        z = jnp.zeros((p.z, p.y, p.x), jnp.float32)
+        return fn, (z, z)
+
+    kernels = capture_traffic(build)
+    return {
+        "kernels": [kt.report() for kt in kernels],
+        "padded": [p.z, p.y, p.x],
+        "base": [spec.base.z, spec.base.y, spec.base.x],
+        "k": k,
+    }
+
+
+def substep() -> dict:
+    """Astaroth fused RK3 substep at 64^3 (8 fp32 fields, inline-halo
+    layout): the (ty+16)/ty x px/nx input-amplification claim."""
+    from stencil_tpu.astaroth import config as ac_config
+    from stencil_tpu.astaroth.equations import Constants
+    from stencil_tpu.ops.pallas_astaroth import make_pallas_substep, pick_tiles
+
+    n = 64
+    info = ac_config.AcMeshInfo()
+    conf = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "stencil_tpu", "apps",
+    )
+    from stencil_tpu.apps.astaroth import DEFAULT_CONF
+
+    with open(DEFAULT_CONF) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    c = Constants.from_info(info)
+    inv_ds = (
+        info.real_params["AC_inv_dsx"],
+        info.real_params["AC_inv_dsy"],
+        info.real_params["AC_inv_dsz"],
+    )
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
+    p = spec.padded()
+    tz, ty = pick_tiles(spec)
+
+    def build():
+        fn = make_pallas_substep(spec, c, inv_ds, substep=1, dt=1e-3)
+        z = tuple(jnp.zeros((p.z, p.y, p.x), jnp.float32) for _ in range(8))
+        return (lambda cu, ou: fn(cu, ou)), (z, z)
+
+    kernels = capture_traffic(build)
+    return {
+        "kernels": [kt.report() for kt in kernels],
+        "padded": [p.z, p.y, p.x],
+        "base": [spec.base.z, spec.base.y, spec.base.x],
+        "tiles": [tz, ty],
+    }
+
+
+def fill_x() -> dict:
+    """In-place x halo fill at 256^3 r=3: the documented edge-lane-tile RMW
+    amplification (any inline-x-halo layout pays 128-lane writes)."""
+    from stencil_tpu.ops.halo_fill import _x_tzb, make_self_fill
+
+    spec = GridSpec(Dim3(256, 256, 256), Dim3(1, 1, 1), Radius.constant(3))
+    p = spec.padded()
+
+    def build():
+        fn = make_self_fill(spec, "x")
+        z = jnp.zeros((p.z, p.y, p.x), jnp.float32)
+        return fn, (z,)
+
+    kernels = capture_traffic(build)
+    return {
+        "kernels": [kt.report() for kt in kernels],
+        "padded": [p.z, p.y, p.x],
+        "tzb": _x_tzb(spec),
+        "radius": 3,
+    }
+
+
+def main(argv) -> int:
+    which = argv[1] if len(argv) > 1 else "multistep"
+    if which == "multistep":
+        rep = multistep(int(argv[2]) if len(argv) > 2 else 4)
+    elif which == "substep":
+        rep = substep()
+    elif which == "fill-x":
+        rep = fill_x()
+    else:
+        raise SystemExit(f"unknown target {which!r}")
+    print(json.dumps(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
