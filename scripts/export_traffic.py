@@ -62,9 +62,6 @@ def substep() -> dict:
 
     n = 64
     info = ac_config.AcMeshInfo()
-    conf = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "..", "stencil_tpu", "apps",
-    )
     from stencil_tpu.apps.astaroth import DEFAULT_CONF
 
     with open(DEFAULT_CONF) as f:

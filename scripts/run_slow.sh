@@ -13,8 +13,8 @@ set -u
 cd "$(dirname "$0")/.." || exit 1
 SHARD=${1:-1}
 NSHARDS=${2:-3}
-if [ "$SHARD" -lt 1 ] || [ "$SHARD" -gt "$NSHARDS" ]; then
-  echo "shard must be in 1..$NSHARDS" >&2
+if [ "$NSHARDS" -lt 1 ] || [ "$SHARD" -lt 1 ] || [ "$SHARD" -gt "$NSHARDS" ]; then
+  echo "shard must be in 1..$NSHARDS (nshards >= 1)" >&2
   exit 2
 fi
 
@@ -30,4 +30,10 @@ for i in "${!ALL[@]}"; do
   if [ $((i % NSHARDS)) -eq $((SHARD - 1)) ]; then SEL+=("${ALL[$i]}"); fi
 done
 echo "slow shard $SHARD/$NSHARDS: ${#SEL[@]} of ${#ALL[@]} tests"
+if [ "${#SEL[@]}" -eq 0 ]; then
+  # bare `pytest -m slow` would run the WHOLE tier — an empty shard must
+  # run nothing
+  echo "empty shard"
+  exit 0
+fi
 exec python -m pytest -m slow -q "${SEL[@]}"

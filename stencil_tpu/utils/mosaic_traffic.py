@@ -138,17 +138,21 @@ def _parse_module(name: str, lines: Sequence[str]) -> KernelTraffic:
         if m:
             src = _parse_ref(m.group(1))
             dst = _parse_ref(m.group(2))
-            if src and dst:
-                dmas.append(
-                    DmaOp(
-                        src_space=src[2],
-                        dst_space=dst[2],
-                        shape=dst[0],
-                        itemsize=dst[1],
-                        if_depth=sum(1 for f in stack if f == "if"),
-                        loop_depth=sum(1 for f in stack if f == "loop"),
-                    )
+            if src is None or dst is None:
+                # an uncounted DMA would make the byte assertions pass
+                # vacuously — fail loudly instead (e.g. a future Mosaic
+                # printing strided/dynamic memref layouts)
+                raise ValueError(f"unparseable enqueue_dma operands: {ln.strip()}")
+            dmas.append(
+                DmaOp(
+                    src_space=src[2],
+                    dst_space=dst[2],
+                    shape=dst[0],
+                    itemsize=dst[1],
+                    if_depth=sum(1 for f in stack if f == "if"),
+                    loop_depth=sum(1 for f in stack if f == "loop"),
                 )
+            )
         net = ln.count("{") - ln.count("}")
         if net > 0:
             if "scf.if" in ln or "} else {" in ln:
