@@ -60,16 +60,13 @@ from . import bench_exchange, exchange_weak, jacobi3d, measure_overlap
 # runs — ADVICE r3), NOT the 512^3 headline, so the efficiency column
 # compares like with like.
 #
-# STALE until re-recorded: 15383.0 was measured in round 3 when the
-# single-block anchor ran the then-unpinned k=10 multistep; under the k=4
-# pin the anchor is slower, so this constant OVERSTATES the anchor (and
-# understates efficiency) until --record-base re-runs on the chip
-# (round-4 TPU session re-records scripts/weak_base.json, which takes
-# precedence over these constants whenever it exists).
+# Recorded round 5 (2026-07-31, scripts/r05_logs/record_base.log) at the
+# pinned k=4 via --record-base on the chip; scripts/weak_base.json holds
+# the full-precision values and takes precedence whenever it exists.
 DEFAULT_BASE = {
-    "jacobi_mcells_per_s_per_dev": 15383.0,  # 256^3 deep_halo=4 (k=10, stale)
-    "exchange_weak_trimean_s": 5.42e-3,      # 512^3 radius-3 4q self-wrap fill
-    "config2_trimean_s": 2.00e-3,            # 256^3 radius-2 4q self-wrap fill
+    "jacobi_mcells_per_s_per_dev": 14337.0,  # 256^3 deep_halo=4 (k=4 pin)
+    "exchange_weak_trimean_s": 5.41e-3,      # 512^3 radius-3 4q self-wrap fill
+    "config2_trimean_s": 2.21e-3,            # 256^3 radius-2 4q self-wrap fill
 }
 
 
@@ -99,6 +96,16 @@ def run(
     (~87 ms per dispatch on the tunneled platform)."""
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
+    missing = sorted(set(DEFAULT_BASE) - set(base or {}))
+    if missing:
+        # ADVICE r4: make it visible when built-in constants (not a
+        # measured scripts/weak_base.json) anchor any efficiency column —
+        # including a partial --base dict
+        log.warn(
+            "weak-scaling efficiency columns "
+            f"{missing} anchored to built-in DEFAULT_BASE constants; run "
+            "--record-base (or pass a full --base) for measured anchors"
+        )
     base = dict(DEFAULT_BASE, **(base or {}))
     if chunk is None:
         chunk = max(1, iters // 3)

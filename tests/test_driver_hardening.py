@@ -24,6 +24,20 @@ def _broken_env(**extra):
     # any backend init that does not go through the forced-CPU config API
     # now raises instead of silently working
     env["JAX_PLATFORMS"] = "bogus_backend"
+    # the tunnel plugin's sitecustomize (on PYTHONPATH) re-pins
+    # JAX_PLATFORMS at interpreter startup, so with a HEALTHY tunnel the
+    # accel child would ignore the bogus backend and succeed (these tests
+    # first ran during a full outage, where the wedge itself broke the
+    # child) — drop the plugin site dir so the break is
+    # tunnel-state-independent
+    parts = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not any("axon_site" in c for c in p.split(os.sep))
+    ]
+    if parts:
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+    else:
+        env.pop("PYTHONPATH", None)
     env.update(extra)
     return env
 
