@@ -8,10 +8,13 @@ Two kernels over the substep's (tz, rows_in, px) window shape:
 - vpu: dy and d2y of NF fields by shifted sublane slices + weighted sums
   (the production fd.py structure);
 - mxu: the same 2*NF pencils as one [2*ty, rows_in] x [rows_in, px]
-  dot_general per field-plane (bf16x3 fp32 passes on the MXU), no sublane
+  dot_general per field-plane at Precision.HIGHEST (the multi-pass f32
+  decomposition — the only Mosaic-supported precision that passes FD
+  parity; the bf16-truncating DEFAULT fails it, and HIGH is
+  NotImplementedError in the in-kernel dot lowering), no sublane
   realignment at all.
 
-Outputs are cross-checked (rtol 1e-5: matmul reassociates the 7-term sum)
+Outputs are cross-checked (rtol 1e-4: matmul reassociates the 7-term sum)
 and both are timed per substep-equivalent tile count at 512^3.
 
 Usage: python scripts/probe_mxu_taps.py [n]
@@ -91,8 +94,8 @@ def main():
         # precision is REQUIRED for parity: the TPU default truncates f32
         # inputs to bf16 (one MXU pass), a ~2^-8 per-product error that
         # fails any useful FD tolerance (measured: 98% of elements out at
-        # rtol 1e-4, abs ~5e-3). HIGHEST runs the multi-pass f32
-        # decomposition; HIGH the 3-pass bf16x3.
+        # rtol 1e-4, abs ~5e-3). Only HIGHEST (multi-pass f32
+        # decomposition) both parity-passes and lowers in Mosaic.
         def mxu_kernel(win_ref, m_ref, out_ref):
             m = m_ref[...]
             for f in range(NF):
@@ -134,8 +137,10 @@ def main():
             interpret=_interp(),
         )
 
+    # Mosaic's in-kernel dot lowering supports DEFAULT and HIGHEST only
+    # (HIGH raises NotImplementedError, measured round 5); DEFAULT fails
+    # FD parity (bf16 truncation), so HIGHEST is the one usable variant.
     mxu_highest = make_mxu(jax.lax.Precision.HIGHEST)
-    mxu_high = make_mxu(jax.lax.Precision.HIGH)
     rng = np.random.RandomState(11)
     win = jnp.asarray(rng.rand(*win_shape) * 0.1, jnp.float32)
     M = jnp.asarray(M_np)
@@ -145,18 +150,25 @@ def main():
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
     print(f"parity ok at HIGHEST: vpu vs mxu pencils agree (tz,ty)=({tz},{ty}), "
           f"{n_tiles} tiles", flush=True)
-    bh = np.asarray(jax.jit(mxu_high)(win, M))
-    err = np.max(np.abs(bh - a))
-    print(f"HIGH (bf16x3) max|err| vs vpu: {err:.2e}", flush=True)
 
     chunk = 8
+    calls = chunk + 1  # fori seed + chunk body invocations, all timed
+
+    def make_loop(call):
+        # the body input depends on the carry (a zero-scaled scalar), so
+        # the loop-invariant call cannot be hoisted and all `calls`
+        # invocations execute sequentially
+        def f(w):
+            def body(_, o):
+                return call(w + o[0, 0, 0, 0, 0] * 0.0)
+
+            return jax.lax.fori_loop(0, chunk, body, call(w))
+
+        return jax.jit(f)
+
     for label, g in (
-        ("vpu", jax.jit(lambda w: jax.lax.fori_loop(
-            0, chunk, lambda _, o: vpu(w), vpu(w)))),
-        ("mxu-highest", jax.jit(lambda w: jax.lax.fori_loop(
-            0, chunk, lambda _, o: mxu_highest(w, M), mxu_highest(w, M)))),
-        ("mxu-high", jax.jit(lambda w: jax.lax.fori_loop(
-            0, chunk, lambda _, o: mxu_high(w, M), mxu_high(w, M)))),
+        ("vpu", make_loop(lambda w: vpu(w))),
+        ("mxu-highest", make_loop(lambda w: mxu_highest(w, M))),
     ):
         t0 = time.time()
         out = g(win)
@@ -167,7 +179,7 @@ def main():
             t0 = time.perf_counter()
             out = g(win)
             hard_sync(out)
-            st.insert((time.perf_counter() - t0) / chunk)
+            st.insert((time.perf_counter() - t0) / calls)
         print(f"{label}: {st.trimean()*1e3:.3f} ms per substep-equivalent "
               f"({NF} fields x {tz} planes x (dy+d2y) x {n_tiles} tiles; "
               f"compile {cs:.0f}s)", flush=True)

@@ -5,7 +5,10 @@
 # a wedged step is killed and the queue continues.
 #
 # Usage: bash scripts/r04_measure.sh [start_step]
-cd "$(dirname "$0")/.." || exit 1
+# Exit codes: 0 = every step completed; 1..8 = number of failed/timed-out
+# steps; 10 = aborted at the alive gate (tunnel dead, nothing ran);
+# 11 = setup failure before the gate (nothing ran).
+cd "$(dirname "$0")/.." || exit 11
 LOG=${MEASURE_LOG_DIR:-scripts/r04_logs}
 mkdir -p "$LOG"
 START=${1:-1}
@@ -26,7 +29,9 @@ step() {
 # mid-queue) — do not burn budgets against a wedged tunnel or trust a
 # stale alive.log
 timeout 300 python scripts/tpu_alive_probe.py > "$LOG/alive.log" 2>&1
-grep -q "^alive" "$LOG/alive.log" || { echo "TPU not alive; aborting" | tee -a "$LOG/session.log"; exit 1; }
+# exit 10 = aborted at the alive gate (nothing ran) — distinct from the
+# failed-step count (max 8) so callers can branch on rc alone
+grep -q "^alive" "$LOG/alive.log" || { echo "TPU not alive; aborting" | tee -a "$LOG/session.log"; exit 10; }
 echo "=== alive gate passed ($(date +%H:%M:%S))" | tee -a "$LOG/session.log"
 
 # 2. 512^3 substep autotune table (VERDICT item 2)

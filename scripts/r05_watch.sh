@@ -29,16 +29,18 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     MEASURE_LOG_DIR=$LOG bash scripts/r04_measure.sh >> "$LOG/watch.log" 2>&1
     rc=$?
     QUEUE_RUNS=$((QUEUE_RUNS + 1))
-    echo "$(date +%FT%T) queue run $QUEUE_RUNS done rc=$rc (0 = all steps completed)" >> "$LOG/watch.log"
-    if grep -q '^alive' "$LOG/alive.log"; then
+    echo "$(date +%FT%T) queue run $QUEUE_RUNS done rc=$rc (0 = all steps completed, >=10 = nothing ran)" >> "$LOG/watch.log"
+    if [ "$rc" -lt 10 ]; then
       # The gate passed, so the queue genuinely ran (rc = failed-step
-      # count). Do NOT re-fire the multi-hour queue automatically —
-      # partial logs are valid and resuming a specific step is an
-      # operator decision (bash scripts/r04_measure.sh <step>).
+      # count; the gate abort has its own code). Do NOT re-fire the
+      # multi-hour queue automatically — partial logs are valid and
+      # resuming a specific step is an operator decision
+      # (bash scripts/r04_measure.sh <step>).
       [ "$rc" -eq 0 ] && exit 0 || exit 3
     fi
-    # Gate abort: the probe answered but the tunnel re-wedged before the
-    # queue's own gate (a flap). Keep watching for a real revival.
+    # rc=10 gate abort: the probe answered but the tunnel re-wedged
+    # before the queue's own gate (a flap). Keep watching for a real
+    # revival.
   else
     echo "$ts dead (probe rc=$probe_rc)" >> "$LOG/watch.log"
   fi
