@@ -182,9 +182,12 @@ _H = 3  # 6th-order stencil reach (reference: astaroth.h STENCIL_ORDER 6)
 
 def uses_pallas(ex: HaloExchange, use_pallas, dtype="float32") -> bool:
     """Whether :func:`make_astaroth_step` will take the fused Pallas path
-    for fields of ``dtype`` (None = auto: TPU, fp32, aligned blocks, no
-    resident oversubscription; uneven partitions run the kernel over the
-    padded base extents with dynamic-shell overlap)."""
+    for fields of ``dtype`` (None = auto: TPU, fp32, aligned blocks;
+    uneven partitions run the kernel over the padded base extents with
+    dynamic-shell overlap). Resident (oversubscribed) shards keep the
+    fused kernel — it runs once per stacked block (VERDICT r4 item 7;
+    uneven + resident stays on the XLA path, the dynamic-shell machinery
+    is single-resident)."""
     if use_pallas is not None:
         return bool(use_pallas)
     import jax.numpy as jnp
@@ -192,9 +195,10 @@ def uses_pallas(ex: HaloExchange, use_pallas, dtype="float32") -> bool:
     from ..ops.pallas_astaroth import substep_supported
 
     devs = ex.mesh.devices.flatten()
+    if ex.oversubscribed and not ex.spec.is_uniform():
+        return False
     return (
         all(d.platform == "tpu" for d in devs)
-        and not ex.oversubscribed
         and substep_supported(ex.spec, jnp.dtype(dtype))
     )
 
@@ -291,12 +295,35 @@ def make_astaroth_step(
             for s in range(3)
         ]
         p = spec.padded()
+        nres = ex.resident.flatten()
 
         def to3(d):
             return tuple(d[k].reshape(p.z, p.y, p.x) for k in FIELDS)
 
         def untuple(vals, like):
             return {k: v.reshape(like[k].shape) for k, v in zip(FIELDS, vals)}
+
+        def run_kernel(s, curr, out):
+            """One fused substep over the shard. Resident (oversubscribed)
+            shards stack whole padded blocks along the leading block dims;
+            the per-block kernel runs once per resident, each block's
+            halos filled by the resident-shift exchange phases (the
+            reference's same-GPU fast path under oversubscription,
+            tx_cuda.cuh:41-113)."""
+            if nres == 1:
+                return untuple(kernels[s](to3(curr), to3(out)), out)
+            cf = tuple(curr[k].reshape(nres, p.z, p.y, p.x) for k in FIELDS)
+            of = tuple(out[k].reshape(nres, p.z, p.y, p.x) for k in FIELDS)
+            res = [
+                kernels[s](tuple(c[j] for c in cf), tuple(o[j] for o in of))
+                for j in range(nres)
+            ]
+            return {
+                k: jnp.stack([res[j][i] for j in range(nres)]).reshape(
+                    out[k].shape
+                )
+                for i, k in enumerate(FIELDS)
+            }
 
         def exchange_all(curr):
             return ex.exchange_blocks(curr)
@@ -317,7 +344,7 @@ def make_astaroth_step(
                 # in-place kernel destroys) — exchange-then-compute
                 for s in range(3):
                     curr = exchange_all(curr)
-                    out = untuple(kernels[s](to3(curr), to3(out)), out)
+                    out = run_kernel(s, curr, out)
                     curr, out = out, curr
                 return curr, out
             # reference swap-per-iteration mode: the in buffers are constant
@@ -329,7 +356,7 @@ def make_astaroth_step(
             # multi-block-axis shells from the exchanged halos afterwards
             # is exact; substeps 1 and 2 read post-exchange data directly.
             if use_overlap and multi_block:
-                out = untuple(kernels[0](to3(curr), to3(out)), out)
+                out = run_kernel(0, curr, out)
                 curr = exchange_all(curr)
                 for rect in exteriors:
                     if tight_x:
@@ -342,7 +369,7 @@ def make_astaroth_step(
                 # uneven partition: same structure, shells at per-block
                 # dynamic offsets (substep 0 never reads out, so the full
                 # kernel pass before the shells is exact)
-                out = untuple(kernels[0](to3(curr), to3(out)), out)
+                out = run_kernel(0, curr, out)
                 curr = exchange_all(curr)
                 _, shells = _dyn_geometry()
                 for lo, size in shells:
@@ -351,9 +378,9 @@ def make_astaroth_step(
                     )
             else:
                 curr = exchange_all(curr)
-                out = untuple(kernels[0](to3(curr), to3(out)), out)
+                out = run_kernel(0, curr, out)
             for s in (1, 2):
-                out = untuple(kernels[s](to3(curr), to3(out)), out)
+                out = run_kernel(s, curr, out)
             return out, curr  # one swap per iteration (astaroth.cu:642-648)
 
     else:

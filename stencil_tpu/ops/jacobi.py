@@ -336,7 +336,7 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
         inner = Rect3(compute.lo + shrink_lo, compute.hi - shrink_hi)
         pallas_shells = exterior_regions(compute, inner)
 
-    nres = ex.resident.z * ex.resident.y * ex.resident.x
+    nres = ex.resident.flatten()
 
     def body(curr, nxt, sel):
         if pallas_sweep is not None:
