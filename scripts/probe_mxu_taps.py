@@ -36,6 +36,7 @@ from stencil_tpu.geometry import Dim3, Radius
 from stencil_tpu.ops.pallas_astaroth import NF, pick_tiles
 from stencil_tpu.utils.statistics import Statistics
 from stencil_tpu.utils.sync import hard_sync
+from stencil_tpu.utils.timer import chained_calls
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
 H = 3
@@ -151,25 +152,11 @@ def main():
     print(f"parity ok at HIGHEST: vpu vs mxu pencils agree (tz,ty)=({tz},{ty}), "
           f"{n_tiles} tiles", flush=True)
 
-    chunk = 8
-    calls = chunk + 1  # fori seed + chunk body invocations, all timed
-
-    def make_loop(call):
-        # the body input depends on the carry (a zero-scaled scalar), so
-        # the loop-invariant call cannot be hoisted and all `calls`
-        # invocations execute sequentially
-        def f(w):
-            def body(_, o):
-                return call(w + o[0, 0, 0, 0, 0] * 0.0)
-
-            return jax.lax.fori_loop(0, chunk, body, call(w))
-
-        return jax.jit(f)
-
-    for label, g in (
-        ("vpu", make_loop(lambda w: vpu(w))),
-        ("mxu-highest", make_loop(lambda w: mxu_highest(w, M))),
-    ):
+    loops = {
+        "vpu": chained_calls(lambda w: vpu(w)),
+        "mxu-highest": chained_calls(lambda w: mxu_highest(w, M)),
+    }
+    for label, (g, calls) in loops.items():
         t0 = time.time()
         out = g(win)
         hard_sync(out)

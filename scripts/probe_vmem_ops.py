@@ -30,6 +30,7 @@ from stencil_tpu.domain.grid import GridSpec
 from stencil_tpu.geometry import Dim3, Radius
 from stencil_tpu.ops.pallas_astaroth import NF, pick_tiles
 from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.timer import chained_calls
 from stencil_tpu.utils.sync import hard_sync
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
@@ -89,9 +90,7 @@ def window_shift_bench():
     )
     rng = np.random.RandomState(3)
     seed = jnp.asarray(rng.rand(W, rows_in, px), jnp.float32)
-    chunk = 8
-    g = jax.jit(lambda s0: jax.lax.fori_loop(
-        0, chunk, lambda _, o: fn(s0), fn(s0)))
+    g, calls = chained_calls(fn)
     t0 = time.time()
     out = g(seed)
     hard_sync(out)
@@ -107,7 +106,7 @@ def window_shift_bench():
         t0 = time.perf_counter()
         out = g(seed)
         hard_sync(out)
-        st.insert((time.perf_counter() - t0) / chunk)
+        st.insert((time.perf_counter() - t0) / calls)
     per_call = st.trimean()
     print(
         f"window-shift {n}^3 (tz,ty)=({tz},{ty}): {per_call*1e3:.3f} ms per "
@@ -169,9 +168,7 @@ def y_ring_bench():
     )
     rng = np.random.RandomState(5)
     seed = jnp.asarray(rng.rand(tz + 2, rows, px), jnp.float32)
-    chunk = 8
-    g = jax.jit(lambda s0: jax.lax.fori_loop(
-        0, chunk, lambda _, o: fn(s0), fn(s0)))
+    g, calls = chained_calls(fn)
     t0 = time.time()
     out = g(seed)
     hard_sync(out)
@@ -185,7 +182,7 @@ def y_ring_bench():
         t0 = time.perf_counter()
         out = g(seed)
         hard_sync(out)
-        st.insert((time.perf_counter() - t0) / chunk)
+        st.insert((time.perf_counter() - t0) / calls)
     print(
         f"y-ring {n}^3 (tz,ty)=({tz},{ty}) k={k}: {st.trimean()*1e3:.3f} ms "
         f"per multistep call ({copies} row copies x {n_tiles} tiles of "

@@ -70,3 +70,26 @@ def time_fn(bucket: str):
         return wrapper
 
     return deco
+
+
+def chained_calls(call, chunk: int = 8):
+    """Build a jitted timing loop of ``chunk + 1`` sequential invocations
+    of ``call`` (one array argument -> one array result).
+
+    The fori seed is a real invocation and each body input depends on the
+    carry through a zero-scaled scalar, so XLA can neither hoist the
+    loop-invariant call nor CSE the chain — every invocation executes, in
+    order, even for pure (non-side-effecting) kernels. Returns
+    ``(g, calls)``: time ``g(x)`` and divide by ``calls``. (One probe
+    divided a 9-call chain by 8 and another relied on side-effect
+    ordering alone — this helper is the single corrected idiom.)
+    """
+    import jax
+
+    def f(x):
+        def body(_, o):
+            return call(x + o[(0,) * o.ndim] * 0.0)
+
+        return jax.lax.fori_loop(0, chunk, body, call(x))
+
+    return jax.jit(f), chunk + 1
