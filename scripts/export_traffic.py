@@ -53,14 +53,15 @@ def multistep(k: int) -> dict:
     }
 
 
-def substep() -> dict:
-    """Astaroth fused RK3 substep at 64^3 (8 fp32 fields, inline-halo
-    layout): the (ty+16)/ty x px/nx input-amplification claim."""
+def substep(n: int = 64, tight_x: bool = False) -> dict:
+    """Astaroth fused RK3 substep (8 fp32 fields): the (ty+16)/ty x px/nx
+    input-amplification claim. ``tight_x`` builds the Radius.without_x
+    layout (px == nx — the x amplification factor the tight layout
+    removes); ``n`` picks the config (256 = the production tiling)."""
     from stencil_tpu.astaroth import config as ac_config
     from stencil_tpu.astaroth.equations import Constants
     from stencil_tpu.ops.pallas_astaroth import make_pallas_substep, pick_tiles
 
-    n = 64
     info = ac_config.AcMeshInfo()
     from stencil_tpu.apps.astaroth import DEFAULT_CONF
 
@@ -74,7 +75,8 @@ def substep() -> dict:
         info.real_params["AC_inv_dsy"],
         info.real_params["AC_inv_dsz"],
     )
-    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
+    r = Radius.constant(3).without_x() if tight_x else Radius.constant(3)
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), r)
     p = spec.padded()
     tz, ty = pick_tiles(spec)
 
@@ -119,7 +121,11 @@ def main(argv) -> int:
     if which == "multistep":
         rep = multistep(int(argv[2]) if len(argv) > 2 else 4)
     elif which == "substep":
-        rep = substep()
+        mode = argv[3] if len(argv) > 3 else "inline"
+        if mode not in ("inline", "tight"):
+            raise SystemExit(f"unknown substep layout {mode!r} (inline|tight)")
+        rep = substep(int(argv[2]) if len(argv) > 2 else 64,
+                      tight_x=mode == "tight")
     elif which == "fill-x":
         rep = fill_x()
     else:

@@ -93,8 +93,13 @@ def test_multistep_traffic_is_k_independent():
         assert fused_planes / serial_planes < 2.2 / k
 
 
-def test_substep_steady_state_amplification():
-    rep = _report("substep")
+@pytest.mark.parametrize(
+    "n,tight",
+    [(64, False), (128, True), (256, True), (256, False)],
+    ids=["64-inline", "128-tight-x", "256-production-tight", "256-inline"],
+)
+def test_substep_steady_state_amplification(n, tight):
+    rep = _report("substep", str(n), *(["tight"] if tight else []))
     (k,) = rep["kernels"]
     tz, ty = rep["tiles"]
     pz, py, px = rep["padded"]
@@ -117,8 +122,7 @@ def test_substep_steady_state_amplification():
     # steady-state input amplification: PARSED bytes of the per-field
     # stage fetch vs the compulsory (tz, ty, nx) fp32 tile. Must equal the
     # documented (ty+16)/ty x px/nx model exactly — at the 256^3
-    # production pick ty=128 the y factor is 144/128 = 1.125 ("~1.12"),
-    # and the x factor is the lane padding px/nx (1.0 under tight-x)
+    # production pick ty=128 the y factor is 144/128 = 1.125 ("~1.12")
     stage_bytes = [
         d["bytes"] for d in k["dmas"]
         if d["dir"] == "in" and tuple(d["shape"]) == (tz, ty + 16, px)
@@ -126,6 +130,13 @@ def test_substep_steady_state_amplification():
     compulsory = tz * ty * nx * 4
     amp = stage_bytes[0] / compulsory
     assert amp == pytest.approx((1 + 16 / ty) * (px / nx), rel=1e-12)
+    if tight:
+        # tight-x (Radius.without_x): px == nx — the lane-pad x factor the
+        # layout exists to remove is exactly 1 in the compiled artifact
+        assert px == nx
+    if n == 256:
+        # the production pick's documented y window: ty=128 -> 1.125
+        assert ty == 128
 
 
 def test_fill_x_rewrites_edge_lane_tiles_only():
