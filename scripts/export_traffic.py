@@ -6,7 +6,7 @@ lowering recursion is incompatible with pytest's rewritten frames — same
 trick as export_overlap_hlo.py); also usable standalone:
 
     python scripts/export_traffic.py multistep 4
-    python scripts/export_traffic.py substep
+    python scripts/export_traffic.py substep [n] [inline|tight]
     python scripts/export_traffic.py fill-x
 
 Prints one JSON line: {"kernels": [KernelTraffic.report(), ...], ...extras}.
@@ -124,8 +124,14 @@ def main(argv) -> int:
         mode = argv[3] if len(argv) > 3 else "inline"
         if mode not in ("inline", "tight"):
             raise SystemExit(f"unknown substep layout {mode!r} (inline|tight)")
-        rep = substep(int(argv[2]) if len(argv) > 2 else 64,
-                      tight_x=mode == "tight")
+        try:
+            n = int(argv[2]) if len(argv) > 2 else 64
+        except ValueError:
+            raise SystemExit(
+                f"substep size must be an integer, got {argv[2]!r} "
+                "(usage: substep [n] [inline|tight])"
+            )
+        rep = substep(n, tight_x=mode == "tight")
     elif which == "fill-x":
         rep = fill_x()
     else:

@@ -135,7 +135,7 @@ def run(
         if k not in loops:
             # an explicit deep_halo pins the temporal depth at k=deep_halo on
             # EVERY device count — a single-block run would otherwise take
-            # k=10 (no radius bound) and poison weak-scaling efficiency
+            # the full default depth (no radius bound) and poison weak-scaling
             # columns against radius-capped N-chip runs (ADVICE r3)
             tk = deep_halo if deep_halo >= 2 else None
             loops[k] = (

@@ -207,7 +207,8 @@ def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pal
 
     ``temporal_k`` caps the temporal-blocking depth explicitly. Weak-scaling
     comparisons need it: a single-block mesh has no radius bound and would
-    run k=10 while an N-chip deep-halo run is capped at the realized radius,
+    run the full default depth (k=12) while an N-chip deep-halo run is
+    capped at the realized radius,
     conflating temporal depth with scaling in the efficiency column
     (ADVICE r3).
     """
@@ -436,16 +437,18 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
 
     # temporal blocking: advance k steps per HBM pass when the loop is
     # fused — the stencil is purely memory-bound, so HBM traffic drops
-    # ~1/k. Measured at 512^3 on v5e: k=2 5.69 ms/step, k=6 3.88, k=10
-    # 3.20 (the k->inf floor is the in-VMEM wavefront cost, ~3 ms), so
-    # depth is capped at 10 and further bounded by the z extent (pipeline
-    # needs nz >= 2k+1) and by the staging planes fitting the VMEM budget
-    # ((k-1)*3 + 6 full planes). On a single block every axis self-wraps
-    # in-kernel; on a uniform multi-block mesh the same kernel runs in
-    # deep-halo mode — one radius-k exchange per k steps (the
-    # communication-avoiding scheme; k is then also bounded by the
-    # realized multi-block-axis radii, so drivers opt in by realizing
-    # with radius k).
+    # ~1/k. The depth cap is re-measured whenever the kernels change
+    # (STENCIL_TEMPORAL_K_CAP probes deeper): the pre-tight-x kernels
+    # plateaued at k=10 (3.20 ms/step, round 2); the tight-x kernels
+    # plateau at k=12 (512^3 round 5: k=10 1.752, k=12 1.695, k=13 1.696
+    # ms/iter — scripts/r05_logs/k512.log). Depth is further bounded by
+    # the z extent (pipeline needs nz >= 2k+1) and by the staging planes
+    # fitting the VMEM budget ((k-1)*3 + 6 full planes). On a single
+    # block every axis self-wraps in-kernel; on a uniform multi-block
+    # mesh the same kernel runs in deep-halo mode — one radius-k exchange
+    # per k steps (the communication-avoiding scheme; k is then also
+    # bounded by the realized multi-block-axis radii, so drivers opt in
+    # by realizing with radius k).
     multistep = None
     deep_halo = False
     TEMPORAL_K = 0
@@ -454,11 +457,20 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     # block edges (deep-halo x needs radius >= k, which tight-x lacks)
     if (pallas_sweep is not None and pallas_axes is not None and not side_x
             and standard_spheres and iters and spec.is_uniform()):
+        import os
+
         p = spec.padded()
         plane = p.y * p.x * 4
         budget = 46 * 1024 * 1024  # measured compile ceiling minus headroom
         k_mem = (budget // plane - 6) // 3 + 1
-        k_cap = max(0, min(10, (spec.base.z - 1) // 2, iters, k_mem))
+        try:
+            hard_cap = int(os.environ.get("STENCIL_TEMPORAL_K_CAP", "12"))
+        except ValueError as e:
+            raise ValueError(
+                "STENCIL_TEMPORAL_K_CAP must be an integer, got "
+                f"{os.environ['STENCIL_TEMPORAL_K_CAP']!r}"
+            ) from e
+        k_cap = max(0, min(hard_cap, (spec.base.z - 1) // 2, iters, k_mem))
         if temporal_k is not None:
             k_cap = min(k_cap, temporal_k)
         if pallas_axes:
