@@ -1,0 +1,69 @@
+"""Probe: astaroth 256^3 with the 2x2x2 partition fully RESIDENT on one
+chip — the first hardware number for the flagship MHD workload under
+oversubscription, now that resident shards keep the fused Pallas substep
+(round 5; the reference's same-GPU fast path under oversubscription,
+tx_cuda.cuh:41-113). Mirrors apps/astaroth.py's iteration discipline.
+
+Usage: python scripts/probe_resident_astaroth.py [n] [iters]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import numpy as np
+
+from stencil_tpu.astaroth import config as ac_config
+from stencil_tpu.astaroth.integrate import FIELDS, make_astaroth_step, uses_pallas
+from stencil_tpu.apps.astaroth import DEFAULT_CONF
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel import HaloExchange, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+on_accel = jax.devices()[0].platform != "cpu"
+iters = int(sys.argv[2]) if len(sys.argv) > 2 else (30 if on_accel else 2)
+
+info = ac_config.AcMeshInfo()
+with open(DEFAULT_CONF) as f:
+    ac_config.parse_config(f.read(), info)
+info.int_params["AC_nx"] = info.int_params["AC_ny"] = info.int_params["AC_nz"] = n
+info.update_builtin_params()
+
+spec = GridSpec(Dim3(n, n, n), Dim3(2, 2, 2), Radius.constant(3))
+mesh = grid_mesh(Dim3(1, 1, 1), jax.devices()[:1])
+ex = HaloExchange(spec, mesh)
+assert tuple(ex.resident) == (2, 2, 2), ex.resident
+pallas = uses_pallas(ex, None)
+print(f"resident astaroth {n}^3 2x2x2 on 1 device: pallas={pallas}", flush=True)
+
+step = make_astaroth_step(ex, info, dt=1e-8, overlap=True, iters=iters)
+rng = np.random.RandomState(17)
+curr = {
+    k: shard_blocks((rng.randn(n, n, n) * 0.05).astype(np.float32), spec, mesh)
+    for k in FIELDS
+}
+nxt = {
+    k: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
+    for k in FIELDS
+}
+t0 = time.time()
+curr, nxt = step(curr, nxt)
+hard_sync(curr)
+print(f"compile+first {time.time()-t0:.0f}s", flush=True)
+st = Statistics()
+for _ in range(3):
+    t0 = time.perf_counter()
+    curr, nxt = step(curr, nxt)
+    hard_sync(curr)
+    st.insert((time.perf_counter() - t0) / iters)
+finite = bool(np.isfinite(np.asarray(jax.device_get(curr["lnrho"]))).all())
+print(
+    f"astaroth-resident {n}^3 2x2x2 on 1 chip: {st.trimean()*1e3:.2f} ms/iter "
+    f"(pallas={pallas}, finite={finite}, {iters} iters/dispatch)",
+    flush=True,
+)
