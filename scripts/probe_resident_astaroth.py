@@ -61,7 +61,9 @@ for _ in range(3):
     curr, nxt = step(curr, nxt)
     hard_sync(curr)
     st.insert((time.perf_counter() - t0) / iters)
-finite = bool(np.isfinite(np.asarray(jax.device_get(curr["lnrho"]))).all())
+finite = all(
+    bool(np.isfinite(np.asarray(jax.device_get(curr[k]))).all()) for k in FIELDS
+)
 print(
     f"astaroth-resident {n}^3 2x2x2 on 1 chip: {st.trimean()*1e3:.2f} ms/iter "
     f"(pallas={pallas}, finite={finite}, {iters} iters/dispatch)",

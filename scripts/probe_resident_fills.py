@@ -1,0 +1,102 @@
+"""Probe: the z-stacked resident self-fill path on REAL TPU hardware.
+
+A (cz,1,1) z-stack keeps the in-place Pallas x/y halo fills by folding
+the shard into one (cz*pz, py, px) view (round 5, halo_fill.py z_stack).
+The interpret-mode tests pin parity; this probe runs the production
+wiring on the chip: verifies every resident block's halos against the
+position-coded pattern, and times the exchange with fills vs the XLA
+slab fallback (use the env knob STENCIL_PROBE_NO_FILLS=1 to compare).
+
+Usage: python scripts/probe_resident_fills.py [n] [cz]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import numpy as np
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel import HaloExchange, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+cz = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+on_accel = jax.devices()[0].platform != "cpu"
+chunk = 120 if on_accel else 3
+
+spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, cz), Radius.constant(2))
+mesh = grid_mesh(Dim3(1, 1, 1), jax.devices()[:1])
+ex = HaloExchange(spec, mesh)
+assert tuple(ex.resident) == (1, 1, cz), ex.resident
+if os.environ.get("STENCIL_PROBE_NO_FILLS"):
+    ex.__dict__["_self_fills"] = {}
+fills = sorted(ex._self_fills)
+print(f"resident fills {n}^3 z-stack cz={cz}: active fills = {fills}", flush=True)
+
+# position-coded pattern: value = z*65536 + y*256 + x — for n <= 256
+# every packed value is an integer < 2^24, exactly representable in fp32
+g = spec.global_size
+assert g.x <= 256 and g.y <= 256 and g.z <= 256
+coords = (
+    np.arange(g.z)[:, None, None] * 65536.0
+    + np.arange(g.y)[None, :, None] * 256.0
+    + np.arange(g.x)[None, None, :]
+).astype(np.float32)
+state = {0: shard_blocks(coords, spec, mesh)}
+t0 = time.time()
+state = ex(state)
+hard_sync(state)
+print(f"compile+first {time.time()-t0:.0f}s", flush=True)
+
+# verify every resident block's FULL halo ring (vectorized: every cell
+# of the padded block whose local coord falls outside the compute region
+# and inside the halo reach)
+arr = np.asarray(jax.device_get(state[0]))
+off = spec.compute_offset()
+r = spec.radius
+bz = g.z // cz
+p3 = spec.padded()
+lz = np.arange(p3.z) - off.z  # block-local compute coords
+ly = np.arange(p3.y) - off.y
+lx = np.arange(p3.x) - off.x
+in_z = (lz >= -r.z(-1)) & (lz < bz + r.z(1))
+in_y = (ly >= -r.y(-1)) & (ly < g.y + r.y(1))
+in_x = (lx >= -r.x(-1)) & (lx < g.x + r.x(1))
+core_z = (lz >= 0) & (lz < bz)
+core_y = (ly >= 0) & (ly < g.y)
+core_x = (lx >= 0) & (lx < g.x)
+reach = in_z[:, None, None] & in_y[None, :, None] & in_x[None, None, :]
+core = core_z[:, None, None] & core_y[None, :, None] & core_x[None, None, :]
+halo = reach & ~core
+bad = checked = 0
+for j in range(cz):
+    want = (
+        ((j * bz + lz[:, None, None]) % g.z) * 65536.0
+        + (ly[None, :, None] % g.y) * 256.0
+        + (lx[None, None, :] % g.x)
+    ).astype(np.float32)
+    mism = (arr[j, 0, 0] != want) & halo
+    checked += int(halo.sum())
+    bad += int(mism.sum())
+print(f"halo check: {checked} cells, {bad} bad", flush=True)
+assert bad == 0
+
+loop = ex.make_loop(chunk)
+state = loop(state)
+hard_sync(state)
+st = Statistics()
+for _ in range(3):
+    t0 = time.perf_counter()
+    state = loop(state)
+    hard_sync(state)
+    st.insert((time.perf_counter() - t0) / chunk)
+print(
+    f"resident-fills exchange {n}^3 cz={cz} r2 1q: "
+    f"{st.trimean()*1e3:.3f} ms/exchange (fills={bool(fills)})",
+    flush=True,
+)
