@@ -7,7 +7,7 @@ trick as export_overlap_hlo.py); also usable standalone:
 
     python scripts/export_traffic.py multistep 4
     python scripts/export_traffic.py substep [n] [inline|tight]
-    python scripts/export_traffic.py fill-x
+    python scripts/export_traffic.py fill-x|fill-y|fill-z
 
 Prints one JSON line: {"kernels": [KernelTraffic.report(), ...], ...extras}.
 """
@@ -94,26 +94,33 @@ def substep(n: int = 64, tight_x: bool = False) -> dict:
     }
 
 
-def fill_x() -> dict:
-    """In-place x halo fill at 256^3 r=3: the documented edge-lane-tile RMW
-    amplification (any inline-x-halo layout pays 128-lane writes)."""
+def fill(axis: str) -> dict:
+    """In-place halo fill at 256^3 r=3 for one self-wrap axis: x pins the
+    edge-lane-tile RMW amplification (any inline-x-halo layout pays
+    128-lane writes), y the 8-row-tile RMW windows, z the staged whole
+    plane copies."""
     from stencil_tpu.ops.halo_fill import _x_tzb, make_self_fill
 
     spec = GridSpec(Dim3(256, 256, 256), Dim3(1, 1, 1), Radius.constant(3))
     p = spec.padded()
 
     def build():
-        fn = make_self_fill(spec, "x")
+        fn = make_self_fill(spec, axis)
         z = jnp.zeros((p.z, p.y, p.x), jnp.float32)
         return fn, (z,)
 
     kernels = capture_traffic(build)
-    return {
+    rep = {
         "kernels": [kt.report() for kt in kernels],
         "padded": [p.z, p.y, p.x],
-        "tzb": _x_tzb(spec),
         "radius": 3,
+        "offset": [spec.compute_offset().z, spec.compute_offset().y,
+                   spec.compute_offset().x],
+        "base": [spec.base.z, spec.base.y, spec.base.x],
     }
+    if axis == "x":
+        rep["tzb"] = _x_tzb(spec)
+    return rep
 
 
 def main(argv) -> int:
@@ -132,8 +139,8 @@ def main(argv) -> int:
                 "(usage: substep [n] [inline|tight])"
             )
         rep = substep(n, tight_x=mode == "tight")
-    elif which == "fill-x":
-        rep = fill_x()
+    elif which in ("fill-x", "fill-y", "fill-z"):
+        rep = fill(which[-1])
     else:
         raise SystemExit(f"unknown target {which!r}")
     print(json.dumps(rep))

@@ -29,6 +29,7 @@ cz = int(sys.argv[2]) if len(sys.argv) > 2 else 2
 on_accel = jax.devices()[0].platform != "cpu"
 chunk = 120 if on_accel else 3
 
+assert n % cz == 0, "the halo check assumes a uniform z split"
 spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, cz), Radius.constant(2))
 mesh = grid_mesh(Dim3(1, 1, 1), jax.devices()[:1])
 ex = HaloExchange(spec, mesh)

@@ -139,6 +139,43 @@ def test_substep_steady_state_amplification(n, tight):
         assert ty == 128
 
 
+def test_fill_y_rmw_row_tiles_only():
+    rep = _report("fill-y")
+    (k,) = rep["kernels"]
+    pz, py, px = rep["padded"]
+    r = rep["radius"]
+    g = _groups(k)
+    tile = (8, 8, px)
+    # per z batch: 4 row-tile reads (dest + wrap-source windows, both
+    # sides) and 2 writes, all unconditional — the 8-row-tile RMW
+    # economics of ops/halo_fill.py:15 ("RMW of 4 row-tiles")
+    assert g[("in", tile)] == 4 and g[("out", tile)] == 2
+    assert len(k["dmas"]) == 6
+    assert all(d["if_depth"] == 0 and d["loop_depth"] == 0 for d in k["dmas"])
+    assert k["grid"] == [-(-pz // 8)]
+    # written rows per batch vs the 2r logical halo rows: the 8-row
+    # minimum write granularity
+    written = sum(d["bytes"] for d in k["dmas"] if d["dir"] == "out")
+    logical = 2 * r * 8 * px * 4
+    assert written / logical == pytest.approx(16 / 6, rel=1e-12)
+
+
+def test_fill_z_stages_whole_planes():
+    rep = _report("fill-z")
+    (k,) = rep["kernels"]
+    pz, py, px = rep["padded"]
+    r = rep["radius"]
+    g = _groups(k)
+    plane = (r, py, px)
+    # one grid step, two staged copies (top r planes -> lo halo, first r
+    # planes -> hi halo), each a read + write of exactly r whole planes:
+    # z halos have NO write amplification (the untiled dim)
+    assert g[("in", plane)] == 2 and g[("out", plane)] == 2
+    assert len(k["dmas"]) == 4
+    assert all(d["if_depth"] == 0 and d["loop_depth"] == 0 for d in k["dmas"])
+    assert k["grid"] == [1]
+
+
 def test_fill_x_rewrites_edge_lane_tiles_only():
     rep = _report("fill-x")
     (k,) = rep["kernels"]
