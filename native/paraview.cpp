@@ -11,6 +11,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -36,13 +37,29 @@ inline char *fmt_double(char *p, double v) {
         v = -v;
     }
     char buf[48];  // shortest scientific: "d[.ddd]e±dd"
-    auto res = std::to_chars(buf, buf + sizeof buf, v,
-                             std::chars_format::scientific);
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+    const char *end =
+        std::to_chars(buf, buf + sizeof buf, v, std::chars_format::scientific)
+            .ptr;
+#else
+    // libstdc++ < GCC 11 ships integer-only to_chars. Shortest round-trip
+    // by precision search instead: %.*e rounds to the CLOSEST (p+1)-digit
+    // scientific string, so the first precision whose strtod round-trips
+    // is exactly the shortest-round-trip digit string to_chars picks.
+    int len = 0;
+    for (int prec = 0; prec <= 17; ++prec) {
+        len = std::snprintf(buf, sizeof buf, "%.*e", prec, v);
+        if (std::strtod(buf, nullptr) == v) break;
+    }
+    const char *end = buf + len;
+#endif
     char digits[40];
     int nd = 0;
     const char *q = buf;
     digits[nd++] = *q++;
-    if (*q == '.') {
+    // ',' too: the snprintf fallback is locale-dependent where to_chars
+    // is not, and a comma-decimal LC_NUMERIC must not corrupt the scan
+    if (*q == '.' || *q == ',') {
         ++q;
         while (*q != 'e') digits[nd++] = *q++;
     }
@@ -50,7 +67,7 @@ inline char *fmt_double(char *p, double v) {
     const int esign = (*q == '-') ? -1 : 1;
     ++q;
     int E = 0;
-    while (q < res.ptr) E = E * 10 + (*q++ - '0');
+    while (q < end) E = E * 10 + (*q++ - '0');
     E *= esign;
     if (E >= -4 && E < 16) {  // fixed
         if (E >= nd - 1) {

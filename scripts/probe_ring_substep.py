@@ -1,0 +1,52 @@
+"""A/B the astaroth sliding-window variants at 512^3 on the chip.
+
+Settles the round-5 floor contradiction (VERDICT r5 weak #1): the closure
+summed a 12.7 ms *standalone* window-shift leg into the 70.5 ms substep
+floor, but the round-3 in-situ probe measured only 0.4 ms for removing the
+shifts inside the kernel — both cannot be additive truths. The ring
+variant (ops/pallas_astaroth.py, ``variant="ring"``) removes the shift ops
+entirely with CORRECT results, so this probe is the decisive in-situ
+measurement:
+
+- delta ~ 12 ms/substep  -> the shifts really serialized at 512^3; the
+  ring window recovers more than the 10.5 ms gap to the 60 ms/substep
+  target (the 180 ms/iter flagship target reopens and likely falls);
+- delta <~ 1 ms/substep -> the shifts hide under DMA/VPU contention; the
+  12.7 ms standalone leg was never a floor term and BASELINE.md's closure
+  must carry this delta instead.
+
+Bench discipline as bench.py's astaroth legs: fused chunks, untimed
+warmup chunk, trimean over chunk means, hard_sync. Run on the TPU host:
+
+  python scripts/probe_ring_substep.py [n] [iters] [chunk]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+
+from stencil_tpu.apps.astaroth import run  # noqa: E402
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+iters = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 6
+
+if jax.devices()[0].platform != "tpu":
+    print("WARNING: no TPU — numbers below are CPU-interpret smoke only",
+          flush=True)
+    n, iters, chunk = 32, 4, 2
+
+results = {}
+for variant in ("shift", "ring"):
+    r = run(iters=iters, devices=jax.devices()[:1], dtype="float32",
+            nx=n, chunk=chunk, kernel_variant=variant)
+    ms = r["iter_trimean_s"] * 1e3
+    results[variant] = ms
+    print(f"{variant}: {ms:.2f} ms/iter = {ms/3:.2f} ms/substep "
+          f"({n}^3, {r['iters_run']} iters)", flush=True)
+
+delta = (results["shift"] - results["ring"]) / 3
+print(f"ring saves {delta:.2f} ms/substep "
+      f"({'the shifts serialized — floor leg stands' if delta > 6 else 'the shifts hid under DMA/VPU — retire the 12.7 ms leg'})",
+      flush=True)

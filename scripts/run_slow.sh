@@ -13,16 +13,29 @@ set -u
 cd "$(dirname "$0")/.." || exit 1
 SHARD=${1:-1}
 NSHARDS=${2:-3}
+# integer validation BEFORE the range checks: a non-numeric arg must hit
+# the usage message, not an arithmetic error inside [ -lt ] (ADVICE r5 #2)
+case "$SHARD" in
+  ''|*[!0-9]*) echo "usage: run_slow.sh <shard 1..N> <nshards> (SHARD must be an integer, got '$SHARD')" >&2; exit 2 ;;
+esac
+case "$NSHARDS" in
+  ''|*[!0-9]*) echo "usage: run_slow.sh <shard 1..N> <nshards> (NSHARDS must be an integer, got '$NSHARDS')" >&2; exit 2 ;;
+esac
 if [ "$NSHARDS" -lt 1 ] || [ "$SHARD" -lt 1 ] || [ "$SHARD" -gt "$NSHARDS" ]; then
   echo "shard must be in 1..$NSHARDS (nshards >= 1)" >&2
   exit 2
 fi
 
 # stable shard assignment: sorted node ids, round-robin by index (clustered
-# same-file parametrizations spread across shards)
-mapfile -t ALL < <(python -m pytest tests/ -q --collect-only -m slow 2>/dev/null | grep '::' | sort)
+# same-file parametrizations spread across shards). Collection stderr goes
+# to a temp file so an import error is distinguishable from a genuinely
+# empty tier (ADVICE r5 #2).
+COLLECT_ERR=$(mktemp)
+trap 'rm -f "$COLLECT_ERR"' EXIT
+mapfile -t ALL < <(python -m pytest tests/ -q --collect-only -m slow 2>"$COLLECT_ERR" | grep '::' | sort)
 if [ "${#ALL[@]}" -eq 0 ]; then
-  echo "collected no slow tests" >&2
+  echo "collected no slow tests; collect-only stderr follows:" >&2
+  cat "$COLLECT_ERR" >&2
   exit 2
 fi
 SEL=()

@@ -73,6 +73,7 @@ def run(
     dt: float = 1e-8,
     use_pallas=None,
     chunk: int = 1,
+    kernel_variant: Optional[str] = None,
 ) -> dict:
     """Run ``iters`` iterations (plus one untimed warmup chunk) and return
     timing stats + the domain.
@@ -194,6 +195,7 @@ def run(
             use_pallas=use_pallas,
             dtype=dtype,
             iters=chunk,
+            kernel_variant=kernel_variant,
         )
         curr, nxt = step(curr, nxt)  # compile + warm (one chunk)
         hard_sync(curr)
@@ -287,6 +289,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--reductions", action="store_true", help="print field reductions")
     p.add_argument("--no-pallas", action="store_true",
                    help="force the unfused XLA substep path")
+    p.add_argument("--kernel-variant", choices=("shift", "ring"), default=None,
+                   help="fused-substep sliding-window discipline: 'shift' "
+                        "(plane-copy window shifts, the recorded kernel) or "
+                        "'ring' (shift-free modular-slot rotation); default "
+                        "reads STENCIL_ASTAROTH_VARIANT, else 'shift'")
     p.add_argument("--chunk", type=int, default=1,
                    help="iterations fused per dispatch (benchmarking; a "
                         "final partial chunk still runs a full chunk)")
@@ -319,6 +326,7 @@ def main(argv: Optional[list] = None) -> int:
         reductions=args.reductions,
         use_pallas=False if args.no_pallas else None,
         chunk=args.chunk,
+        kernel_variant=args.kernel_variant,
     )
     print(csv_row(r))
     log.info(timer.report())

@@ -63,6 +63,7 @@ def run(
     warmup: int = 1,
     chunk: Optional[int] = None,
     deep_halo: int = 1,
+    multistep_rows: Optional[int] = None,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -140,7 +141,8 @@ def run(
             tk = deep_halo if deep_halo >= 2 else None
             loops[k] = (
                 make_jacobi_loop(dd.halo_exchange, k, overlap=overlap,
-                                 temporal_k=tk)
+                                 temporal_k=tk,
+                                 multistep_rows=multistep_rows)
                 if k > 1
                 else make_jacobi_step(dd.halo_exchange, overlap=overlap)
             )
@@ -226,6 +228,11 @@ def main(argv: Optional[list] = None) -> int:
                    help="realize radius-K halos so the fused loop advances K "
                         "steps per exchange on multi-block meshes "
                         "(communication-avoiding temporal blocking)")
+    p.add_argument("--multistep-rows", type=int, default=None,
+                   help="force the temporal multistep's row-strip height "
+                        "(default: automatic — full planes while they reach "
+                        "the depth cap, row-tiled staging beyond; the "
+                        "probing knob for the 768^3 depth regime)")
     args = p.parse_args(argv)
 
     if args.cpu:
@@ -246,6 +253,7 @@ def main(argv: Optional[list] = None) -> int:
         checkpoint_period=args.checkpoint_period,
         prefix=args.prefix,
         deep_halo=args.deep_halo,
+        multistep_rows=args.multistep_rows,
     )
     print(csv_row(r))
     log.info(f"mcells/s = {r['mcells_per_s']:.1f} ({r['mcells_per_s_per_dev']:.1f}/device)")

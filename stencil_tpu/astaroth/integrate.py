@@ -29,6 +29,7 @@ recorded numbers keep the 3-substep structure.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Tuple
 
 import jax
@@ -213,6 +214,7 @@ def make_astaroth_step(
     use_pallas=None,
     dtype="float32",
     interpret: bool = False,
+    kernel_variant: str = None,
 ):
     """Build the jitted iteration: ``fn(curr, nxt) -> (curr, nxt)`` where
     curr/nxt are dicts of stacked sharded field arrays. Runs ``iters``
@@ -230,7 +232,13 @@ def make_astaroth_step(
     pre-exchange data); the multi-block-axis shells of substep 0 are then
     re-integrated from the exchanged halos — the reference's
     interior/exterior overlap re-expressed as dataflow with the fused
-    kernel as the interior."""
+    kernel as the interior.
+
+    ``kernel_variant`` selects the fused kernel's sliding-window
+    discipline: ``"shift"`` (plane-copy window shifts) or ``"ring"``
+    (shift-free modular-slot rotation — ops/pallas_astaroth.py module
+    docstring). ``None`` reads ``STENCIL_ASTAROTH_VARIANT`` (default
+    ``shift``) so the A/B runs without touching call sites."""
     spec = ex.spec
     r = spec.radius
     assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
@@ -283,6 +291,9 @@ def make_astaroth_step(
         from ..ops.pallas_astaroth import make_pallas_substep
         from ..parallel.mesh import MESH_AXES
 
+        variant = kernel_variant or os.environ.get(
+            "STENCIL_ASTAROTH_VARIANT", "shift"
+        )
         # interpret mode (CI integration tests): the pallas HLO interpreter
         # cannot propagate varying-manual-axes metadata, so drop the vma
         # annotations and disable shard_map's vma check for this step
@@ -291,6 +302,7 @@ def make_astaroth_step(
                 spec, c, inv_ds, s, dt,
                 vma=None if interpret else MESH_AXES,
                 interpret=interpret,
+                variant=variant,
             )
             for s in range(3)
         ]

@@ -190,7 +190,8 @@ def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None,
 
 def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pallas=None,
                      standard_spheres: bool = True, interpret: bool = False,
-                     temporal_k: Optional[int] = None):
+                     temporal_k: Optional[int] = None,
+                     multistep_rows: Optional[int] = None):
     """Like :func:`make_jacobi_step` but runs ``iters`` iterations inside one
     compiled program (``lax.fori_loop``) — one host dispatch per chunk.
 
@@ -211,10 +212,15 @@ def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pal
     capped at the realized radius,
     conflating temporal depth with scaling in the efficiency column
     (ADVICE r3).
+
+    ``multistep_rows`` forces the multistep's row-strip height (None =
+    :func:`~stencil_tpu.ops.pallas_stencil.plan_multistep_staging` picks:
+    full planes while they reach the depth, row strips beyond) — the
+    probing knob behind ``jacobi3d --multistep-rows``.
     """
     return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas,
                            standard_spheres=standard_spheres, interpret=interpret,
-                           temporal_k=temporal_k)
+                           temporal_k=temporal_k, multistep_rows=multistep_rows)
 
 
 def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
@@ -232,7 +238,8 @@ def _want_pallas(ex: HaloExchange, use_pallas) -> bool:
 
 def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
                     standard_spheres: bool = True, interpret: bool = False,
-                    temporal_k: Optional[int] = None):
+                    temporal_k: Optional[int] = None,
+                    multistep_rows: Optional[int] = None):
     spec = ex.spec
     r = spec.radius
     assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
@@ -452,6 +459,7 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     multistep = None
     deep_halo = False
     TEMPORAL_K = 0
+    STRIP_ROWS = None
     # side_x is excluded: its empty/partial pallas_axes would read as
     # "self-wrap" to the multistep, whose in-kernel x wrap is wrong at
     # block edges (deep-halo x needs radius >= k, which tight-x lacks)
@@ -459,10 +467,9 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
             and standard_spheres and iters and spec.is_uniform()):
         import os
 
-        p = spec.padded()
-        plane = p.y * p.x * 4
+        from .pallas_stencil import plan_multistep_staging
+
         budget = 46 * 1024 * 1024  # measured compile ceiling minus headroom
-        k_mem = (budget // plane - 6) // 3 + 1
         try:
             hard_cap = int(os.environ.get("STENCIL_TEMPORAL_K_CAP", "12"))
         except ValueError as e:
@@ -470,9 +477,9 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
                 "STENCIL_TEMPORAL_K_CAP must be an integer, got "
                 f"{os.environ['STENCIL_TEMPORAL_K_CAP']!r}"
             ) from e
-        k_cap = max(0, min(hard_cap, (spec.base.z - 1) // 2, iters, k_mem))
+        k_want = max(0, min(hard_cap, (spec.base.z - 1) // 2, iters))
         if temporal_k is not None:
-            k_cap = min(k_cap, temporal_k)
+            k_want = min(k_want, temporal_k)
         if pallas_axes:
             # multi-block: the fused multistep subsumes the overlap
             # structure, so it only engages when overlap was requested —
@@ -485,11 +492,35 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
                     (spec.dim.x > 1, r.x(-1), r.x(1)),
                 ) if m for rr in (rl, rh)
             ]
-            k_cap = min(k_cap, *r_mb)
+            k_want = min(k_want, *r_mb)
+        # staging plan: full planes while they reach k_want, row strips
+        # when the plane size would otherwise self-cap the depth (the
+        # 768^3 regime: k=4 full-plane -> k=12 row-tiled)
+        k_cap, STRIP_ROWS = plan_multistep_staging(spec, k_want, budget)
+        if multistep_rows is not None:
+            from .pallas_stencil import valid_strip_rows
+
+            assert valid_strip_rows(spec, k_cap, multistep_rows), (
+                f"multistep_rows={multistep_rows} illegal for k={k_cap}, "
+                f"ny={spec.base.y}"
+            )
+            STRIP_ROWS = multistep_rows
+        if pallas_axes:
             deep_halo = overlap and k_cap >= 2
             TEMPORAL_K = k_cap if deep_halo else 0
         else:
             TEMPORAL_K = k_cap
+    if multistep_rows is not None and TEMPORAL_K < 2:
+        # a probe run must never attribute legacy-path numbers to row
+        # tiling because the multistep quietly failed to engage
+        from ..utils import logging as log
+
+        log.warn(
+            f"multistep_rows={multistep_rows} ignored: the temporal "
+            "multistep did not engage (overlap off, non-uniform partition, "
+            "side-buffer tight-x, iters/radius too small, or non-Pallas "
+            "path) — timings reflect the per-step kernels"
+        )
     if TEMPORAL_K >= 2:
         from .pallas_stencil import make_pallas_jacobi_multistep
         from ..parallel.mesh import MESH_AXES
@@ -497,6 +528,7 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
         multistep = make_pallas_jacobi_multistep(
             spec, TEMPORAL_K,
             vma=None if interpret else MESH_AXES, interpret=interpret,
+            rows=STRIP_ROWS,
         )
 
     def entry_fn(curr, nxt, sel):

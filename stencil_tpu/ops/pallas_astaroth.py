@@ -32,6 +32,22 @@ composition provides them). The kernel writes compute cells only: out's
 halo columns/rows/planes keep their prior contents (refreshed by the next
 exchange before any read).
 
+Window discipline — two selectable variants (``variant=``):
+
+- ``"shift"`` (the round-3 kernel): the window is kept physically ordered
+  in VMEM; every non-strip-start tile copies the 2*H halo planes down
+  (``win[f, 0:2H] = win[f, tz:tz+2H]``) before appending the fresh planes.
+- ``"ring"``: shift-free modular-slot rotation — the same math the jacobi
+  multistep uses for its plane slots (ops/pallas_stencil.py). Window plane
+  j of tile zi lives at physical slot ``(zi*tz + j) % W``; the append
+  stores the fresh planes into the recycled slots (planes tile zi-1 read
+  last — the lag-1 rule holds trivially for in-body VMEM stores) and the
+  compute reads per-plane at dynamic slots, reassembled by concatenation.
+  Eliminates NF*2H plane copies per tile at the price of dynamic-index
+  addressing; built to settle the round-5 floor contradiction (the
+  12.7 ms standalone window-shift leg vs the 0.4 ms in-situ probe —
+  VERDICT r5 weak #1, scripts/probe_ring_substep.py is the on-chip A/B).
+
 Buffering discipline (the documented lag-1 rule: a DMA started at grid
 step t may write a buffer last touched by compute at step t-1, never one
 step t itself reads):
@@ -146,26 +162,41 @@ class _SlabView:
 
     ``wrap_nx``: tight-x layout — the window carries exactly nx columns
     with no halos, and x-shifted pencil reads become in-VMEM lane rolls
-    (out[j] = base[(j + dx) mod nx], the periodic neighborhood)."""
+    (out[j] = base[(j + dx) mod nx], the periodic neighborhood).
 
-    __slots__ = ("ref", "pre", "wrap_nx")
+    ``zmap``: ring-indexed window — maps a logical window plane j to its
+    (traced) physical slot. Slices over z are then read plane-by-plane at
+    dynamic slots and reassembled by concatenation (the slot math of the
+    jacobi multistep, ops/pallas_stencil.py)."""
 
-    def __init__(self, ref, pre, wrap_nx=None):
+    __slots__ = ("ref", "pre", "wrap_nx", "zmap")
+
+    def __init__(self, ref, pre, wrap_nx=None, zmap=None):
         self.ref = ref
         self.pre = pre
         self.wrap_nx = wrap_nx
+        self.zmap = zmap
 
-    def __getitem__(self, idx):
-        assert isinstance(idx, tuple) and idx[0] is Ellipsis, idx
+    def _read(self, zidx, ysl, xsl):
         nx = self.wrap_nx
         if nx is not None:
-            zsl, ysl, xsl = idx[1:]
             dx = xsl.start  # tight layout: xsl == slice(dx, nx + dx)
             assert xsl.stop - dx == nx, (xsl, nx)
             if dx != 0:
-                base = self.ref[self.pre + (zsl, ysl, slice(0, nx))]
+                base = self.ref[self.pre + (zidx, ysl, slice(0, nx))]
                 return pltpu.roll(base, (-dx) % nx, 2)
-        return self.ref[self.pre + idx[1:]]
+        return self.ref[self.pre + (zidx, ysl, xsl)]
+
+    def __getitem__(self, idx):
+        assert isinstance(idx, tuple) and idx[0] is Ellipsis, idx
+        zsl, ysl, xsl = idx[1:]
+        if self.zmap is None:
+            return self._read(zsl, ysl, xsl)
+        parts = [
+            self._read(pl.ds(self.zmap(j), 1), ysl, xsl)
+            for j in range(zsl.start, zsl.stop)
+        ]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
 
 
 def make_pallas_substep(
@@ -178,12 +209,18 @@ def make_pallas_substep(
     vma=None,
     tiles: Tuple[int, int] = None,
     _skip_shift: bool = False,  # timing probe only: wrong results
+    variant: str = "shift",
 ):
     """Build ``fn(curr8, out8) -> out8`` over padded (pz, py, px) fp32
     blocks: one RK3 stage for all fields, out buffers updated in place.
 
-    ``curr8``/``out8`` are tuples ordered like :data:`FIELDS`."""
+    ``curr8``/``out8`` are tuples ordered like :data:`FIELDS`.
+    ``variant``: ``"shift"`` (plane-copy window shifts) or ``"ring"``
+    (shift-free modular-slot rotation) — see the module docstring."""
     assert substep_supported(spec, jnp.float32)
+    assert variant in ("shift", "ring"), variant
+    ring = variant == "ring"
+    assert not (ring and _skip_shift), "_skip_shift probes the shift variant"
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     off = spec.compute_offset()
@@ -222,6 +259,19 @@ def make_pallas_substep(
         n3 = (t + 1) % 3
         y0 = yo + yi * ty
         z0 = zo + zi * tz
+        # ring variant: logical window plane j of tile zi lives at physical
+        # slot (zi*tz + j) % W; a strip start (zi == 0) is offset 0, so the
+        # full-window DMA below needs no variant-specific handling
+        zmap = (lambda j: jnp.mod(zi * tz + j, W)) if ring else None
+
+        def win_planes(f, j0, ysl, xsl):
+            """win[f, j0:j0+tz, ysl, xsl] in logical window order."""
+            if not ring:
+                return win[f, j0 : j0 + tz, ysl, xsl]
+            parts = [
+                win[f, pl.ds(zmap(j0 + i), 1), ysl, xsl] for i in range(tz)
+            ]
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
 
         def tile_zy(ti):
             return zo + (ti % n_tz) * tz, yo + (ti // n_tz) * ty
@@ -300,12 +350,18 @@ def make_pallas_substep(
             for f in range(NF):
                 stage_dma(zi % 2, zi, f).wait()
             for f in range(NF):
-                # shift the window down by tz planes, then append the fresh
-                # planes (the RHS loads fully before the store, so the
-                # overlapping ranges are safe)
-                if not _skip_shift:
-                    win[f, 0 : 2 * H] = win[f, tz : tz + 2 * H]
-                win[f, 2 * H : 2 * H + tz] = stage[zi % 2, f]
+                if ring:
+                    # shift-free: store the fresh planes into the recycled
+                    # ring slots (planes tile zi-1 read last)
+                    for i in range(tz):
+                        win[f, zmap(2 * H + i)] = stage[zi % 2, f, i]
+                else:
+                    # shift the window down by tz planes, then append the
+                    # fresh planes (the RHS loads fully before the store,
+                    # so the overlapping ranges are safe)
+                    if not _skip_shift:
+                        win[f, 0 : 2 * H] = win[f, tz : tz + 2 * H]
+                    win[f, 2 * H : 2 * H + tz] = stage[zi % 2, f]
 
         if substep:
             for f in range(NF):
@@ -322,7 +378,11 @@ def make_pallas_substep(
         # implementation (reference: solve<step>, user_kernels.h:437-469)
         fds = [
             field_data(
-                _SlabView(win, (f,), wrap_nx=nx if tight_x else None), rect, ids
+                _SlabView(
+                    win, (f,), wrap_nx=nx if tight_x else None, zmap=zmap
+                ),
+                rect,
+                ids,
             )
             for f in range(NF)
         ]
@@ -338,7 +398,7 @@ def make_pallas_substep(
         rates[7] = entropy(c, ss, uu, lnrho, aa)
 
         for f in range(NF):
-            curr_c = win[f, H : H + tz, 8 : 8 + ty, wxs]
+            curr_c = win_planes(f, H, slice(8, 8 + ty), wxs)
             if substep:
                 old = out_v[s3, f, :, :, wxs]
                 new = curr_c + beta * (
@@ -351,7 +411,7 @@ def make_pallas_substep(
             else:
                 # non-compute columns carry curr so the store covers whole
                 # aligned rows
-                out_v[s3, f] = win[f, H : H + tz, 8 : 8 + ty, :]
+                out_v[s3, f] = win_planes(f, H, slice(8, 8 + ty), slice(None))
                 out_v[s3, f, :, :, wxs] = new
 
         for f in range(NF):

@@ -24,23 +24,51 @@ from typing import Dict, List, Set, Tuple
 _ID_RE = re.compile(r"%[A-Za-z0-9_]+")
 
 
-def _main_body(mlir_text: str) -> List[str]:
-    """Lines of the @main function body (where shard_map'd steps inline)."""
+def _func_bodies(mlir_text: str) -> List[Tuple[str, List[str]]]:
+    """(header line, body lines) of every ``func.func`` in the module."""
     lines = mlir_text.splitlines()
-    out: List[str] = []
+    out: List[Tuple[str, List[str]]] = []
+    header = None
+    body: List[str] = []
     depth = 0
-    in_main = False
     for ln in lines:
-        if not in_main:
-            if re.search(r"func\.func .*@main\b", ln):
-                in_main = True
+        if header is None:
+            if re.search(r"func\.func .*@\w+", ln):
+                header = ln
+                body = []
                 depth = ln.count("{") - ln.count("}")
             continue
         depth += ln.count("{") - ln.count("}")
-        out.append(ln)
+        body.append(ln)
         if depth <= 0:
-            break
+            out.append((header, body))
+            header = None
     return out
+
+
+def _main_body(mlir_text: str) -> List[str]:
+    """Lines of the function body holding the step's dataflow.
+
+    On a current jax the shard_map'd step inlines into ``@main`` (as an
+    ``sdy.manual_computation`` region); older releases lower shard_map to
+    a CALL of a private callee, leaving ``@main`` without the collectives.
+    Analyze ``@main`` when it contains them, otherwise the function with
+    the most ``collective_permute`` ops (SSA ids are function-local, so
+    the graph must never mix functions)."""
+    funcs = _func_bodies(mlir_text)
+    main = next(
+        (b for h, b in funcs if re.search(r"@main\b", h)), []
+    )
+    if any("collective_permute" in ln for ln in main):
+        return main
+    best = max(
+        funcs,
+        key=lambda hb: sum("collective_permute" in ln for ln in hb[1]),
+        default=(None, main),
+    )
+    if sum("collective_permute" in ln for ln in best[1]):
+        return best[1]
+    return main
 
 
 def build_graph(mlir_text: str) -> Dict[str, Tuple[str, List[str]]]:

@@ -1,0 +1,107 @@
+"""Compatibility shims for older jax releases.
+
+The package is written against the current jax spelling of three APIs the
+kernels and the distributed step depend on:
+
+- ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)``
+  (older releases only have ``jax.experimental.shard_map.shard_map`` with a
+  ``check_rep`` argument and no vma machinery);
+- ``jax.ShapeDtypeStruct(..., vma=...)`` — the varying-manual-axes
+  annotation Pallas outputs need inside ``shard_map`` when vma checking
+  exists (older releases have no ``vma`` kwarg, and nothing to annotate);
+- ``pltpu.CompilerParams`` (older: ``pltpu.TPUCompilerParams``, without
+  the ``has_side_effects`` field).
+
+On an older jax, :func:`apply` installs equivalents at the public names so
+every call site keeps the one modern spelling; on a current jax it is a
+no-op.  The shims are *degraded* equivalents where the old API has no
+counterpart: vma annotations are dropped (there is no vma checker to feed)
+and ``shard_map`` runs with ``check_rep=False`` (the old replication
+checker has no rules for ``pallas_call``/donated in-place updates, so
+leaving it on rejects valid programs the vma checker accepts).
+
+Also translated: ``jax.config.update("jax_num_cpu_devices", n)`` — the
+apps' virtual-device flag — becomes the ``xla_force_host_platform_device_
+count`` XLA flag when the config option does not exist.  Like the real
+option, it only takes effect before the backend initializes.
+
+Applied from ``stencil_tpu/__init__`` so plain ``import stencil_tpu``
+(tests, apps, probe scripts, driver children) is enough.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+
+def apply() -> None:
+    import jax
+
+    # jax.export is a lazy submodule on some releases; utils/mosaic_traffic
+    # relies on attribute access working after `import jax`
+    try:
+        import jax.export  # noqa: F401
+    except ImportError:  # pragma: no cover - very old jax
+        pass
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=True, **kwargs):
+            # check_rep (the old checker) has no replication rules for
+            # pallas_call or donated in-place aliasing, so it rejects valid
+            # programs regardless of check_vma — run unchecked instead
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False, **kwargs,
+            )
+
+        jax.shard_map = shard_map
+
+    if "vma" not in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters:
+        _sds = jax.ShapeDtypeStruct
+
+        class ShapeDtypeStruct(_sds):
+            """ShapeDtypeStruct accepting (and dropping) the vma kwarg."""
+
+            def __init__(self, shape, dtype, *, sharding=None,
+                         weak_type=False, vma=None):
+                super().__init__(
+                    shape, dtype, sharding=sharding, weak_type=weak_type
+                )
+
+        jax.ShapeDtypeStruct = ShapeDtypeStruct
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(pltpu, "CompilerParams"):
+        _params = pltpu.TPUCompilerParams
+        _known = set(inspect.signature(_params.__init__).parameters)
+
+        def CompilerParams(**kwargs):
+            # drop fields the old dataclass lacks (has_side_effects: kernel
+            # liveness is carried by input_output_aliases + used outputs)
+            return _params(**{k: v for k, v in kwargs.items() if k in _known})
+
+        pltpu.CompilerParams = CompilerParams
+
+    try:
+        jax.config.jax_num_cpu_devices  # noqa: B018 - existence probe
+    except AttributeError:
+        _update = jax.config.update
+
+        def update(name, value):
+            if name == "jax_num_cpu_devices":
+                if value and value > 0:
+                    flags = os.environ.get("XLA_FLAGS", "")
+                    if "xla_force_host_platform_device_count" not in flags:
+                        os.environ["XLA_FLAGS"] = (
+                            flags
+                            + f" --xla_force_host_platform_device_count={value}"
+                        ).strip()
+                return
+            return _update(name, value)
+
+        jax.config.update = update

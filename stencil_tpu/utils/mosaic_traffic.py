@@ -26,6 +26,10 @@ from typing import Callable, List, Sequence, Tuple
 
 _MARKER = "The Mosaic module for pallas_call kernel at "
 
+# string literals must not contribute to region-brace counting (MLIR
+# sym_name / location attributes may contain braces)
+_STRLIT = re.compile(r'"(?:[^"\\]|\\.)*"')
+
 _ITEMSIZE = {"f32": 4, "f64": 8, "i32": 4, "bf16": 2, "f16": 2, "i8": 1, "i64": 8}
 
 _MEMREF = re.compile(
@@ -34,6 +38,10 @@ _MEMREF = re.compile(
 _DMA = re.compile(
     r"tpu\.enqueue_dma\s+source\((.*?)\)\s+target\((.*?)\)\s+target_semaphore"
 )
+# older Mosaic prints the GENERIC MLIR form instead:
+#   "tpu.enqueue_dma"(%a, %b, %sem) <{...}> : (memref<src>, memref<dst>, ...)
+# operand order is the same (source, then target); types carry the spaces
+_DMA_GENERIC = re.compile(r'"tpu\.enqueue_dma"\(.*?\).*?:\s*\((.*)\)')
 _BOUNDS = re.compile(r"iteration_bounds = array<i64: ([0-9, ]+)>")
 
 
@@ -128,16 +136,36 @@ def _parse_module(name: str, lines: Sequence[str]) -> KernelTraffic:
     dmas: List[DmaOp] = []
     # region stack: 'if' (scf.if/else region) or 'op' (anything else).
     # Attribute dicts open and close braces on the same line, so only the
-    # NET brace delta of a line changes the stack.
+    # NET brace delta of a line changes the stack. Braces are counted on
+    # the line with its string literals stripped — braces inside MLIR
+    # string attrs (sym_name, location strings) would otherwise silently
+    # skew the if/loop DMA attribution (ADVICE r5 #1).
     stack: List[str] = []
+    opened = False  # the module op's own region has been entered
     for ln in lines:
         b = _BOUNDS.search(ln)
         if b:
             grid = tuple(int(t) for t in b.group(1).replace(" ", "").split(","))
-        m = _DMA.search(ln)
-        if m:
-            src = _parse_ref(m.group(1))
-            dst = _parse_ref(m.group(2))
+        if "tpu.enqueue_dma" in ln:
+            m = _DMA.search(ln)
+            if m:
+                src = _parse_ref(m.group(1))
+                dst = _parse_ref(m.group(2))
+            else:
+                # generic-form printer (older Mosaic): operand memrefs live
+                # in the trailing type signature, source first, target next
+                g = _DMA_GENERIC.search(ln)
+                refs = _MEMREF.findall(g.group(1)) if g else []
+                src = dst = None
+                if len(refs) >= 2:
+                    src, dst = (
+                        (
+                            tuple(int(t) for t in r[0].split("x") if t),
+                            _ITEMSIZE.get(r[1], 4),
+                            r[2],
+                        )
+                        for r in refs[:2]
+                    )
             if src is None or dst is None:
                 # an uncounted DMA would make the byte assertions pass
                 # vacuously — fail loudly instead (e.g. a future Mosaic
@@ -153,19 +181,35 @@ def _parse_module(name: str, lines: Sequence[str]) -> KernelTraffic:
                     loop_depth=sum(1 for f in stack if f == "loop"),
                 )
             )
-        net = ln.count("{") - ln.count("}")
+        bare = _STRLIT.sub('""', ln)
+        net = bare.count("{") - bare.count("}")
         if net > 0:
-            if "scf.if" in ln or "} else {" in ln:
+            if "scf.if" in bare or "} else {" in bare:
                 kind = "if"
-            elif "scf.for" in ln or "scf.while" in ln:
+            elif "scf.for" in bare or "scf.while" in bare:
                 kind = "loop"
             else:
                 kind = "op"
             stack.extend([kind] * net)
+            opened = True
         elif net < 0:
+            if -net > len(stack):
+                raise ValueError(
+                    f"unbalanced region braces in Mosaic dump of {name}: "
+                    f"{-net} closes against a {len(stack)}-deep stack"
+                )
             del stack[net:]
         # '} else {' with net == 0: the closed and opened regions are both
         # arms of the same scf.if — the stack is already correct.
+        if opened and not stack:
+            break  # top-level 'module {' closed; trailing text is not ours
+    if not opened or stack:
+        # a drifted stack would mis-attribute every subsequent DMA's
+        # conditionality — refuse instead of returning skewed counts
+        raise ValueError(
+            f"Mosaic dump of {name} ended with an unbalanced region stack "
+            f"(opened={opened}, depth={len(stack)})"
+        )
     return KernelTraffic(name=name, grid=grid, dmas=dmas)
 
 
@@ -184,6 +228,9 @@ def parse_mosaic_dumps(text: str) -> List[KernelTraffic]:
     return out
 
 
+_capture_active = False
+
+
 def capture_traffic(build: Callable[[], tuple]) -> List[KernelTraffic]:
     """Lower a Pallas-using function for the TPU platform and return the
     DMA inventory of every kernel it contains.
@@ -191,10 +238,26 @@ def capture_traffic(build: Callable[[], tuple]) -> List[KernelTraffic]:
     ``build()`` must CONSTRUCT the kernels (pallas_call must run under the
     patch so the debug dump is enabled) and return ``(fn, args)``; the
     function is then jitted and exported for ``platforms=["tpu"]``.
+
+    Process-global side effects: for the duration of build() + export this
+    patches ``pl.pallas_call`` (forcing ``debug=True`` on every kernel
+    constructed anywhere in the process) and redirects ALL of stdout into
+    the capture buffer. Nested or concurrent use in one process would
+    force debug onto foreign kernels and swallow their output, so reentry
+    raises ``RuntimeError`` — run concurrent captures in subprocesses (the
+    pattern scripts/export_traffic.py uses).
     """
     import jax
     from jax.experimental import pallas as pl
 
+    global _capture_active
+    if _capture_active:
+        raise RuntimeError(
+            "capture_traffic is not reentrant: it patches the process-global "
+            "pl.pallas_call and redirects stdout; run concurrent captures in "
+            "subprocesses"
+        )
+    _capture_active = True
     orig = pl.pallas_call
 
     def patched(*a, **k):
@@ -209,4 +272,5 @@ def capture_traffic(build: Callable[[], tuple]) -> List[KernelTraffic]:
             jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
     finally:
         pl.pallas_call = orig
+        _capture_active = False
     return parse_mosaic_dumps(buf.getvalue())

@@ -152,6 +152,139 @@ def test_pallas_multistep_matches_reference(k):
     )
 
 
+@pytest.mark.parametrize(
+    "k,size,ty",
+    [
+        # ny=40 NOT divisible by ty=16: the final strip re-anchors to
+        # yo + ny - ty and recomputes its overlap with the previous strip
+        (3, Dim3(20, 40, 12), 16),
+        # the target depth regime the row tiling exists for (k >= 8)
+        (8, Dim3(20, 32, 18), 16),
+    ],
+)
+def test_pallas_multistep_row_tiled_matches_reference(k, size, ty):
+    """Row-tiled staging (strips instead of full (py, px) planes): k fused
+    wavefront steps must equal k applications of the numpy periodic
+    reference, spheres included, edge strips' periodic y rows delivered by
+    the wrap-row DMAs (VERDICT r5 weak #2 — 768^3 depth regime)."""
+    import jax.numpy as jnp
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_multistep
+
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1))
+    p = spec.padded()
+    off = spec.compute_offset()
+    fn = make_pallas_jacobi_multistep(spec, k, interpret=True, rows=ty)
+    rng = np.random.RandomState(0)
+    curr = np.zeros((p.z, p.y, p.x), np.float32)
+    sl = (
+        slice(off.z, off.z + size.z),
+        slice(off.y, off.y + size.y),
+        slice(off.x, off.x + size.x),
+    )
+    field = rng.rand(size.z, size.y, size.x).astype(np.float32)
+    curr[sl] = field
+    got = np.asarray(
+        fn(jnp.asarray(curr), jnp.zeros((p.z, p.y, p.x), jnp.float32))
+    )
+    want = jacobi_reference(field, sphere_masks(size), k)
+    np.testing.assert_allclose(
+        got[sl], want, rtol=1e-7 * (2 + k), atol=5e-8 * (1 + k)
+    )
+
+
+def test_pallas_multistep_row_tiled_tight_x():
+    """Row strips compose with the zero-x-radius tight layout (the 768^3
+    production combination: x wrap by lane rolls, y wrap by strip DMAs)."""
+    import jax.numpy as jnp
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.pallas_stencil import make_pallas_jacobi_multistep
+
+    k, ty = 4, 16
+    size = Dim3(128, 32, 14)
+    spec = GridSpec(size, Dim3(1, 1, 1), Radius.constant(1).without_x())
+    assert spec.padded().x == 128 and spec.compute_offset().x == 0
+    p = spec.padded()
+    off = spec.compute_offset()
+    fn = make_pallas_jacobi_multistep(spec, k, interpret=True, rows=ty)
+    rng = np.random.RandomState(3)
+    curr = np.zeros((p.z, p.y, p.x), np.float32)
+    sl = (
+        slice(off.z, off.z + size.z),
+        slice(off.y, off.y + size.y),
+        slice(off.x, off.x + size.x),
+    )
+    curr[sl] = rng.rand(size.z, size.y, size.x)
+    got = np.asarray(fn(jnp.asarray(curr), jnp.zeros_like(curr)))[sl]
+    want = jacobi_reference(curr[sl], sphere_masks(size), k).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_deep_halo_multistep_row_tiled_z_split_matches_xla():
+    """Row-tiled staging under a deep-halo z split (dim 1x1x2, radius 2):
+    strips stage the y wrap while z rides the radius-k exchange — the
+    768^3-per-chip-on-a-z-mesh configuration. Forced via multistep_rows;
+    must match the XLA loop bit-for-bit."""
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.jacobi import make_jacobi_loop, sphere_sel
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
+
+    size = Dim3(16, 32, 20)
+    iters = 4
+    spec = GridSpec(size, Dim3(1, 1, 2), Radius.constant(2))  # k caps at 2
+    mesh = grid_mesh(spec.dim, jax.devices()[:2])
+    ex = HaloExchange(spec, mesh)
+    rng = np.random.RandomState(31)
+    field = rng.rand(size.z, size.y, size.x).astype(np.float32)
+    sel = shard_blocks(sphere_sel(size), spec, mesh)
+
+    outs = {}
+    for label, kwargs in (
+        ("pallas-rows", dict(use_pallas=True, interpret=True,
+                             multistep_rows=16)),
+        ("xla", dict(use_pallas=False)),
+    ):
+        loop = make_jacobi_loop(ex, iters, **kwargs)
+        curr = shard_blocks(field, spec, mesh)
+        nxt = shard_blocks(np.zeros_like(field), spec, mesh)
+        curr, nxt = loop(curr, nxt, sel)
+        outs[label] = unshard_blocks(curr, spec)
+    np.testing.assert_array_equal(outs["pallas-rows"], outs["xla"])
+
+
+def test_plan_multistep_staging_regimes():
+    """The staging planner: full planes while they reach the cap (512^3
+    regime — byte-identical to the round-5 layout), row strips when the
+    plane size would self-cap the depth (the 768^3 regime that measured
+    k=4 / 55.3 Gcells/s on full planes), and a graceful full-plane
+    fallback for multi-block y."""
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.ops.pallas_stencil import (
+        plan_multistep_staging, valid_strip_rows,
+    )
+
+    budget = 46 * 1024 * 1024
+    tight = Radius.constant(1).without_x()
+    s512 = GridSpec(Dim3(512, 512, 512), Dim3(1, 1, 1), tight)
+    k, rows = plan_multistep_staging(s512, 12, budget)
+    assert (k, rows) == (12, None)  # full planes still reach the cap
+
+    s768 = GridSpec(Dim3(768, 768, 768), Dim3(1, 1, 1), tight)
+    k, rows = plan_multistep_staging(s768, 12, budget)
+    assert k >= 8 and rows is not None  # the depth the full planes lost
+    assert valid_strip_rows(s768, k, rows)
+
+    # multi-block y: strips are unsupported — depth degrades, never crashes
+    my = GridSpec(Dim3(768, 768, 768), Dim3(1, 2, 1), Radius.constant(12))
+    k, rows = plan_multistep_staging(my, 12, budget)
+    assert rows is None and k >= 2
+
+
 def test_temporal_k_cap_env(monkeypatch):
     """STENCIL_TEMPORAL_K_CAP overrides the default depth cap (the probe
     knob that re-measures the diminishing-returns point on hardware —
