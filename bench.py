@@ -95,36 +95,48 @@ def _child_main(mode: str) -> int:
     mcells = r["mcells_per_s_per_dev"]
 
     # exchange benchmark: radius-3, 4 float quantities (exchange_weak config,
-    # bin/exchange_weak.cu:49-51,143), fused loop of `chunk` exchanges
+    # bin/exchange_weak.cu:49-51,143), fused loop of `chunk` exchanges.
+    # Timed twice: the manual AXIS_COMPOSED transport and the AUTO_SPMD
+    # strategy whose collectives XLA's partitioner synthesizes — the
+    # tracked manual-vs-auto leg of the bench_mpi_pack ablation
+    # (reference: bin/bench_mpi_pack.cu:18-80; BASELINE.md "auto-SPMD").
     from stencil_tpu.domain.grid import GridSpec
     from stencil_tpu.geometry import Dim3, Radius
-    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
     from stencil_tpu.parallel.exchange import shard_blocks
     import numpy as np
+
+    def _exchange_leg(method) -> float:
+        spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
+        mesh = grid_mesh(spec.dim, jax.devices()[:1])
+        ex = HaloExchange(spec, mesh, method)
+        loop = ex.make_loop(chunk)
+        state = {
+            i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
+            for i in range(4)
+        }
+        state = loop(state)  # compile + warm
+        hard_sync(state)
+        st = Statistics()
+        for _ in range(3):
+            t1 = time.perf_counter()
+            state = loop(state)
+            hard_sync(state)
+            st.insert((time.perf_counter() - t1) / chunk)
+        return ex.bytes_logical([4] * 4) / st.trimean() / 1e9
 
     ex_gb_s = 0.0
     if leg("halo exchange"):
         try:
-            spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
-            mesh = grid_mesh(spec.dim, jax.devices()[:1])
-            ex = HaloExchange(spec, mesh)
-            loop = ex.make_loop(chunk)
-            state = {
-                i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
-                for i in range(4)
-            }
-            state = loop(state)  # compile + warm
-            hard_sync(state)
-            st = Statistics()
-            for _ in range(3):
-                t1 = time.perf_counter()
-                state = loop(state)
-                hard_sync(state)
-                st.insert((time.perf_counter() - t1) / chunk)
-            ex_gb_s = ex.bytes_logical([4] * 4) / st.trimean() / 1e9
-            del state
+            ex_gb_s = _exchange_leg(Method.AXIS_COMPOSED)
         except Exception as e:  # optional leg: record, keep going
             errors["exchange"] = f"{type(e).__name__}: {e}"[:400]
+    ex_auto_gb_s = 0.0
+    if leg("halo exchange (auto-spmd)"):
+        try:
+            ex_auto_gb_s = _exchange_leg(Method.AUTO_SPMD)
+        except Exception as e:
+            errors["exchange_auto"] = f"{type(e).__name__}: {e}"[:400]
 
     # astaroth flagship details (BASELINE configs 4/4b): 8 fp32 fields,
     # fused Pallas RK3 substeps; skipped off-accelerator, via
@@ -186,6 +198,13 @@ def _child_main(mode: str) -> int:
         # like-for-like: same Pallas self-fill leg as the round-2 baseline
         "exchange_vs_baseline": (
             round(ex_gb_s / BASELINE_EXCHANGE_GB_S, 3) if comparable else 0.0
+        ),
+        # the bench_mpi_pack ablation leg: manual transport over the
+        # XLA-synthesized AUTO_SPMD path, same size/radius/quantities
+        # (> 1 means the hand-built exchange wins)
+        "exchange_auto_gb_per_s": round(ex_auto_gb_s, 2),
+        "exchange_manual_over_auto": (
+            round(ex_gb_s / ex_auto_gb_s, 3) if ex_auto_gb_s else 0.0
         ),
         "astaroth_256_iter_ms": asta_ms,
         "astaroth_512_iter_ms": asta512_ms,

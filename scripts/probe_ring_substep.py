@@ -19,21 +19,33 @@ Bench discipline as bench.py's astaroth legs: fused chunks, untimed
 warmup chunk, trimean over chunk means, hard_sync. Run on the TPU host:
 
   python scripts/probe_ring_substep.py [n] [iters] [chunk]
+  python scripts/probe_ring_substep.py --cpu-smoke   # tiny interpret run
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+cpu_smoke = "--cpu-smoke" in sys.argv
+args = [a for a in sys.argv[1:] if a != "--cpu-smoke"]
+
 import jax  # noqa: E402
 
 from stencil_tpu.apps.astaroth import run  # noqa: E402
 
-n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
-iters = int(sys.argv[2]) if len(sys.argv) > 2 else 12
-chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 6
+n = int(args[0]) if len(args) > 0 else 512
+iters = int(args[1]) if len(args) > 1 else 12
+chunk = int(args[2]) if len(args) > 2 else 6
 
 if jax.devices()[0].platform != "tpu":
-    print("WARNING: no TPU — numbers below are CPU-interpret smoke only",
+    if not cpu_smoke:
+        # fail fast and actionably: an interpret-mode "measurement" at this
+        # size would grind for hours and answer nothing (the probe exists
+        # to settle a chip-timing question, ROADMAP #1)
+        sys.exit("probe_ring_substep: no TPU visible (platform="
+                 f"{jax.devices()[0].platform}) — run on the TPU bench host,"
+                 " or pass --cpu-smoke for a tiny interpret-mode sanity run")
+    print("WARNING: --cpu-smoke — numbers below are CPU-interpret smoke only",
           flush=True)
     n, iters, chunk = 32, 4, 2
 

@@ -12,11 +12,16 @@ size; this probe A/Bs:
 Done-bar from VERDICT r5 Next #2: >= 70 Gcells/s at 768^3.
 
   python scripts/probe_rowtile768.py [n] [iters]
+  python scripts/probe_rowtile768.py --cpu-smoke   # tiny CPU run
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+cpu_smoke = "--cpu-smoke" in sys.argv
+args = [a for a in sys.argv[1:] if a != "--cpu-smoke"]
+
 import jax  # noqa: E402
 
 from stencil_tpu.apps.jacobi3d import run  # noqa: E402
@@ -24,8 +29,8 @@ from stencil_tpu.domain.grid import GridSpec  # noqa: E402
 from stencil_tpu.geometry import Dim3, Radius  # noqa: E402
 from stencil_tpu.ops.pallas_stencil import plan_multistep_staging  # noqa: E402
 
-n = int(sys.argv[1]) if len(sys.argv) > 1 else 768
-iters = int(sys.argv[2]) if len(sys.argv) > 2 else 60
+n = int(args[0]) if len(args) > 0 else 768
+iters = int(args[1]) if len(args) > 1 else 60
 
 spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(1).without_x())
 k, rows = plan_multistep_staging(spec, 12, 46 * 1024 * 1024)
@@ -33,7 +38,13 @@ print(f"{n}^3 staging plan: k={k} rows={rows} "
       f"({'row-tiled' if rows else 'full-plane'})", flush=True)
 
 if jax.devices()[0].platform != "tpu":
-    print("WARNING: no TPU — running a tiny CPU smoke instead", flush=True)
+    if not cpu_smoke:
+        # fail fast and actionably: the probe settles a chip wall-clock
+        # question (ROADMAP #2); a CPU run at 768^3 would just churn
+        sys.exit("probe_rowtile768: no TPU visible (platform="
+                 f"{jax.devices()[0].platform}) — run on the TPU bench host,"
+                 " or pass --cpu-smoke for a tiny CPU sanity run")
+    print("WARNING: --cpu-smoke — running a tiny CPU smoke instead", flush=True)
     n, iters = 128, 4
 
 for label, cap in (

@@ -18,6 +18,27 @@ from ..utils.statistics import Statistics
 from ..utils.sync import hard_sync
 
 
+def coord_state(dd, quantities: int):
+    """Deterministic per-quantity coordinate fields on a realized domain
+    (value = z*1e6 + y*1e3 + x + quantity index) — the bit-for-bit
+    agreement fixture shared by the method-ablation harness and the
+    exchange tests (same idiom as tests/test_exchange.py; reference:
+    test_cuda_mpi_distributed_domain.cu:11-17)."""
+    import numpy as np
+
+    from ..parallel.exchange import shard_blocks
+
+    g = dd.spec.global_size
+    coord = (
+        np.arange(g.z)[:, None, None] * 1_000_000.0
+        + np.arange(g.y)[None, :, None] * 1_000.0
+        + np.arange(g.x)[None, None, :]
+    ).astype(np.float32)
+    return {
+        i: shard_blocks(coord + i, dd.spec, dd.mesh) for i in range(quantities)
+    }
+
+
 def placement_from_flags(naive: bool, random_: bool):
     """--naive -> Trivial, --random -> IntraNodeRandom, default NodeAware
     (reference: bin/exchange_weak.cu:149-153, exchange_strong.cu)."""

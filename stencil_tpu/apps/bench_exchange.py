@@ -1,16 +1,22 @@
-"""bench-exchange — radius-shape sweep of the halo exchange.
+"""bench-exchange — radius-shape sweep + method ablation of the halo exchange.
 
 TPU-native port of the reference sweep (reference: bin/bench_exchange.cu):
 five radius shapes (+x-leaning, x-only, faces-only, face+edge, uniform) at a
 fixed per-run extent, reporting trimean seconds and aggregate B/s.
 
-``compare_methods`` additionally rows out AXIS_COMPOSED vs DIRECT26 on the
+``compare_methods``/``ablate`` row out the three exchange strategies on the
 uniform shape — the data-movement-strategy ablation that stands in for the
 reference's bench-mpi-pack pack-kernel-vs-derived-datatype comparison
 (reference: bin/bench_mpi_pack.cu:18-80): composed full-extent slabs (6
-collectives) against exact-extent per-direction messages (26 collectives).
+hand-written collectives) vs exact-extent per-direction messages (26) vs
+``auto-spmd``, where the SPMD partitioner synthesizes the collectives from
+a globally-sharded shifted-slice program. ``--ablate`` additionally pulls
+each compiled program's collective census (op counts + interconnect bytes,
+utils/hlo_check.collective_census) and asserts all three methods produce
+bit-identical halos — the CI gate for the strategy family.
 
 Usage: python -m stencil_tpu.apps.bench_exchange --x 256 --y 256 --z 256 --iters 30
+       python -m stencil_tpu.apps.bench_exchange --cpu 8 --ablate
 """
 
 from __future__ import annotations
@@ -19,10 +25,14 @@ import argparse
 from typing import Optional
 
 import jax
+import numpy as np
 
 from ..geometry import Dim3, Radius
 from ..parallel import Method
-from ._bench_common import time_exchange
+from ._bench_common import coord_state, time_exchange
+
+# ablation order: manual composed, manual direct, partitioner-synthesized
+ABLATE_METHODS = (Method.AXIS_COMPOSED, Method.DIRECT26, Method.AUTO_SPMD)
 
 
 def sweep_radii(face: int = 2, edge: int = 1):
@@ -70,22 +80,21 @@ def run(x, y, z, iters=30, quantities=4, devices=None, method=Method.AXIS_COMPOS
     return rows
 
 
-def compare_methods(x, y, z, iters=30, quantities=4, devices=None, radius=2):
-    """AXIS_COMPOSED vs DIRECT26 at a uniform radius — the pack-strategy
-    ablation (see module docstring). Requires a partition that divides the
-    extents evenly (DIRECT26's uniform-blocks constraint)."""
+def compare_methods(x, y, z, iters=30, quantities=4, devices=None, radius=2,
+                    methods=ABLATE_METHODS):
+    """The three exchange strategies at a uniform radius — the pack-strategy
+    ablation (see module docstring)."""
     devices = list(devices) if devices is not None else jax.devices()
     rows = []
-    for method in (Method.AXIS_COMPOSED, Method.DIRECT26):
+    for method in methods:
         try:
             r = time_exchange(
                 Dim3(x, y, z), Radius.constant(radius), iters, method=method,
                 devices=devices, quantities=quantities,
             )
         except ValueError as e:
-            # DIRECT26 requires uniform blocks; whether the realized
-            # partition (NodePartition inside realize()) divides the
-            # extents evenly is its call — report the skip instead of
+            # a method constraint (e.g. block size < radius after the
+            # NodePartition's split) should report the skip instead of
             # crashing after the main sweep
             print(f"# skipping {method.value}: {e}")
             continue
@@ -95,9 +104,48 @@ def compare_methods(x, y, z, iters=30, quantities=4, devices=None, radius=2):
                 "bytes": r["bytes_logical"],
                 "trimean_s": r["trimean_s"],
                 "bytes_per_s": r["bytes_logical"] / r["trimean_s"],
+                "domain": r["domain"],
             }
         )
     return rows
+
+
+def ablate(x, y, z, iters=30, quantities=4, devices=None, radius=2):
+    """Run all three methods back-to-back at a uniform radius: wall-clock,
+    collective census (counts + interconnect bytes from the compiled HLO),
+    and a bit-for-bit agreement check of one exchange on coordinate fields.
+
+    Returns ``(rows, agree)``; each row carries ``cp_count``/``cp_bytes``
+    (collective-permutes) and ``other_collectives`` (any all-gather/
+    all-reduce/... the partitioner snuck in — 0 for a pure permute plan).
+    Bitwise agreement across ALL methods is only guaranteed at a uniform
+    radius: under anisotropic gating DIRECT26 skips inactive directions
+    that the composed full-extent slabs incidentally fill."""
+    rows = compare_methods(
+        x, y, z, iters=iters, quantities=quantities, devices=devices,
+        radius=radius,
+    )
+    outs = {}
+    for row in rows:
+        dd = row.pop("domain")
+        ex = dd.halo_exchange
+        state = coord_state(dd, quantities)
+        # census first: it only lowers/compiles, so the same state then
+        # feeds (and is donated to) the agreement exchange
+        census = ex.collective_census(state)
+        cp = census.get("collective-permute", (0, 0))
+        row["cp_count"] = cp[0]
+        row["cp_bytes"] = cp[1]
+        row["other_collectives"] = sum(
+            c for k, (c, _b) in census.items() if k != "collective-permute"
+        )
+        out = ex(state)
+        outs[row["config"]] = np.stack(
+            [np.asarray(jax.device_get(out[i])) for i in sorted(out)]
+        )
+    vals = list(outs.values())
+    agree = all(np.array_equal(vals[0], v) for v in vals[1:])
+    return rows, agree
 
 
 def report_header() -> str:
@@ -108,6 +156,17 @@ def report_row(row: dict) -> str:
     return f"{row['config']},{row['bytes']},{row['trimean_s']:e},{row['bytes_per_s']:e}"
 
 
+def ablate_header() -> str:
+    return "config,bytes,trimean (s),B/s,collective-permutes,cp bytes,other collectives"
+
+
+def ablate_row(row: dict) -> str:
+    return (
+        f"{report_row(row)},{row['cp_count']},{row['cp_bytes']},"
+        f"{row['other_collectives']}"
+    )
+
+
 def main(argv: Optional[list] = None) -> int:
     from ..parallel.distributed import maybe_init_from_env
     maybe_init_from_env()
@@ -116,18 +175,34 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--y", type=int, default=256)
     p.add_argument("--z", type=int, default=256)
     p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--method", choices=[m.value for m in Method],
+                   default=Method.AXIS_COMPOSED.value,
+                   help="exchange strategy for the radius sweep")
     p.add_argument("--methods", action="store_true",
-                   help="also compare AXIS_COMPOSED vs DIRECT26 (pack ablation)")
+                   help="also compare the three strategies (pack ablation)")
+    p.add_argument("--ablate", action="store_true",
+                   help="run ONLY the three-method ablation, with collective "
+                        "census columns and a bit-for-bit agreement gate "
+                        "(exit 1 on disagreement)")
     p.add_argument("--cpu", type=int, default=0)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    if args.ablate:
+        rows, agree = ablate(args.x, args.y, args.z, iters=args.iters)
+        print(ablate_header())
+        for row in rows:
+            print(ablate_row(row))
+        print(f"# bit-for-bit agreement: {'PASS' if agree else 'FAIL'}")
+        return 0 if agree and len(rows) == len(ABLATE_METHODS) else 1
     print(report_header())
-    for row in run(args.x, args.y, args.z, iters=args.iters):
+    for row in run(args.x, args.y, args.z, iters=args.iters,
+                   method=Method(args.method)):
         print(report_row(row))
     if args.methods:
         for row in compare_methods(args.x, args.y, args.z, iters=args.iters):
+            row.pop("domain", None)
             print(report_row(row))
     return 0
 

@@ -95,6 +95,7 @@ def run(
     if (pdim is not None and pdim.x == 1 and pdim.flatten() == n
             and size.x % 128 == 0
             and size.y % pdim.y == 0 and size.z % pdim.z == 0
+            and method != Method.AUTO_SPMD  # no in-kernel x wrap globally
             and all(d.platform == "tpu" for d in devices)):
         # tight-x layout: a single-BLOCK x axis wraps x in-kernel (lane
         # rolls), so no x halo columns are allocated — every slab DMA
@@ -219,6 +220,10 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--no-overlap", action="store_true", help="disable interior/exterior overlap")
     p.add_argument("--direct26", action="store_true", help="use 26 per-direction permutes")
+    p.add_argument("--method", choices=[m.value for m in Method], default=None,
+                   help="exchange strategy (auto-spmd lets the SPMD "
+                        "partitioner synthesize the halo collectives; "
+                        "overrides --direct26)")
     p.add_argument("--no-weak", action="store_true", help="fixed total domain (strong)")
     p.add_argument("--paraview", action="store_true")
     p.add_argument("--checkpoint-period", type=int, default=-1)
@@ -246,7 +251,8 @@ def main(argv: Optional[list] = None) -> int:
         args.z,
         iters=args.iters,
         overlap=not args.no_overlap,
-        method=Method.DIRECT26 if args.direct26 else Method.AXIS_COMPOSED,
+        method=Method(args.method) if args.method
+        else (Method.DIRECT26 if args.direct26 else Method.AXIS_COMPOSED),
         devices=jax.devices()[: args.cpu] if args.cpu else None,
         weak=not args.no_weak,
         paraview=args.paraview,

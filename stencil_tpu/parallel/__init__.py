@@ -1,5 +1,8 @@
-from .mesh import AXIS_X, AXIS_Y, AXIS_Z, MESH_AXES, grid_mesh, mesh_dim
-from .exchange import BLOCK_PSPEC, Method, HaloExchange, direction_bytes
+from .mesh import (
+    AXIS_X, AXIS_Y, AXIS_Z, BLOCK_PSPEC, MESH_AXES, block_sharding,
+    grid_mesh, mesh_dim,
+)
+from .exchange import Method, HaloExchange, direction_bytes
 from .placement import IntraNodeRandom, NodeAware, Placement, Trivial, comm_matrix
 from .topology import Boundary, Topology
 
@@ -17,6 +20,7 @@ __all__ = [
     "Placement",
     "Topology",
     "Trivial",
+    "block_sharding",
     "comm_matrix",
     "direction_bytes",
     "grid_mesh",

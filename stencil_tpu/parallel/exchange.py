@@ -9,7 +9,7 @@ ICI torus: ``lax.ppermute`` of boundary slabs inside a ``shard_map``-ped,
 jitted function (SURVEY.md §5.8). "CUDA graph capture" of the exchange
 (packer.cu:96-103) corresponds to the one-time XLA compilation of that jit.
 
-Two exchange strategies are kept (the analogue of the reference's method
+Three exchange strategies are kept (the analogue of the reference's method
 selection, src/stencil.cu:372-412):
 
 - ``Method.AXIS_COMPOSED`` (default): three phases, one per axis, two
@@ -19,9 +19,24 @@ selection, src/stencil.cu:372-412):
   carry both into xz/yz-edges and corners). 6 collectives total,
   independent of radius shape; supports uneven (remainder) partitions via
   per-device dynamic slab offsets.
-- ``Method.DIRECT26``: one ``ppermute`` per active direction with exact
-  extents (the literal translation of the reference's 26 messages); uniform
-  partitions only. Useful for verification and collective-count ablation.
+- ``Method.DIRECT26``: one ``ppermute`` per active direction (the literal
+  translation of the reference's 26 messages) — exact extents on uniform
+  partitions; on uneven (remainder) partitions the orthogonal extents are
+  padded to the base block size and messages apply in face→edge→corner
+  order so every halo cell still ends correct (blocks in the same ring
+  share orthogonal-axis sizes, so the valid slab region always aligns).
+  Useful for verification and collective-count ablation.
+- ``Method.AUTO_SPMD``: NO hand-written collectives at all. The halo fill
+  is expressed as a jitted program over the globally-sharded stacked array
+  — shifted slices rolled along the *block* dims — and XLA's SPMD
+  partitioner synthesizes the collective-permutes. This is the repo's
+  analogue of the reference's ``bench_mpi_pack`` question (bin/
+  bench_mpi_pack.cu:18-80): does hand-built data-movement machinery beat
+  the toolchain's built-in path? Same send-extent rule, periodic wrap,
+  radius shapes, uneven partitions, and oversubscription as AXIS_COMPOSED
+  (the partitioner turns shard-internal shifts into local copies and
+  shard-boundary shifts into permutes on its own); results are required
+  bit-identical (tests/test_auto_spmd.py, bench_exchange --ablate).
 
 Send-extent rule pinned from the reference: the data sent toward direction
 ``d`` fills the receiver's ``-d``-side halo, so its extent is
@@ -39,11 +54,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ..domain.grid import GridSpec
 from ..geometry import DIRECTIONS_26, Dim3, halo_extent
-from .mesh import AXIS_X, AXIS_Y, AXIS_Z, mesh_dim
+from .mesh import AXIS_X, AXIS_Y, AXIS_Z, BLOCK_PSPEC, block_sharding, mesh_dim
 
 # (axis name, stacked-array data dim, Dim3 accessor) in exchange-phase order.
 _AXES = (
@@ -52,9 +67,8 @@ _AXES = (
     (AXIS_Z, 3, "z"),
 )
 
-# The one PartitionSpec of the stacked-block layout (bz, by, bx, pz, py, px):
-# block-grid dims sharded over the mesh, data dims replicated.
-BLOCK_PSPEC = P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None)
+# Stacked-array block dim of each axis (bz, by, bx are dims 0, 1, 2).
+_BDIM = {AXIS_Z: 0, AXIS_Y: 1, AXIS_X: 2}
 
 
 class Method(enum.Enum):
@@ -62,6 +76,7 @@ class Method(enum.Enum):
 
     AXIS_COMPOSED = "axis-composed"
     DIRECT26 = "direct26"
+    AUTO_SPMD = "auto-spmd"
 
 
 def _spec_axis(spec: GridSpec, name: str):
@@ -121,8 +136,6 @@ class HaloExchange:
             spec.dim.x // md.x, spec.dim.y // md.y, spec.dim.z // md.z
         )
         self.resident_z = self.resident.z
-        if method == Method.DIRECT26 and not spec.is_uniform():
-            raise ValueError("Method.DIRECT26 requires a uniform partition")
         for name in (AXIS_X, AXIS_Y, AXIS_Z):
             sizes, rm, rp, _off = _spec_axis(spec, name)
             if min(sizes) < max(rm, rp):
@@ -153,6 +166,13 @@ class HaloExchange:
         ``axes`` (AXIS_* names) restricts the composed method to a subset of
         axis phases — used by fused kernels that handle self-wrap axes
         internally. Only valid for AXIS_COMPOSED."""
+        if self.method == Method.AUTO_SPMD:
+            raise RuntimeError(
+                "Method.AUTO_SPMD has no per-block exchange body: its "
+                "collectives are synthesized by the SPMD partitioner from "
+                "the global program (use __call__/make_loop/auto_fill, or a "
+                "manual method for shard_map composition)"
+            )
         if self.method == Method.DIRECT26:
             assert axes is None, "axis subsetting requires AXIS_COMPOSED"
             return self._direct26_blocks(block)
@@ -195,6 +215,11 @@ class HaloExchange:
         on self-wrap axes share fused multi-quantity fill kernels (the
         multi-quantity-pack analogue, packer.cu:10-26) — one kernel per
         axis phase instead of one per quantity."""
+        if self.method == Method.AUTO_SPMD:
+            raise RuntimeError(
+                "Method.AUTO_SPMD has no per-block exchange body (see "
+                "exchange_block); use __call__/make_loop/auto_fill instead"
+            )
         if not isinstance(state, dict) or self.method == Method.DIRECT26:
             return jax.tree.map(self.exchange_block, state)
         fills = self._self_fills
@@ -246,6 +271,12 @@ class HaloExchange:
 
     @cached_property
     def _compiled(self):
+        if self.method == Method.AUTO_SPMD:
+            sh = self.sharding()
+            return jax.jit(
+                lambda state: jax.tree.map(self.auto_fill, state),
+                in_shardings=sh, out_shardings=sh, donate_argnums=0,
+            )
         fn = jax.shard_map(
             self.exchange_blocks,
             mesh=self.mesh,
@@ -255,7 +286,7 @@ class HaloExchange:
         return jax.jit(fn, donate_argnums=0)
 
     def sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, BLOCK_PSPEC)
+        return block_sharding(self.mesh)
 
     def make_loop(self, iters: int):
         """``iters`` back-to-back exchanges in one compiled program — for
@@ -265,6 +296,19 @@ class HaloExchange:
         program instead of retracing."""
         cache = self.__dict__.setdefault("_loops", {})
         if iters not in cache:
+            if self.method == Method.AUTO_SPMD:
+                def many(state):
+                    return lax.fori_loop(
+                        0, iters,
+                        lambda _, s: jax.tree.map(self.auto_fill, s), state,
+                    )
+
+                sh = self.sharding()
+                cache[iters] = jax.jit(
+                    many, in_shardings=sh, out_shardings=sh, donate_argnums=0
+                )
+                return cache[iters]
+
             def many(state):
                 return lax.fori_loop(
                     0, iters, lambda _, s: self.exchange_blocks(s), state
@@ -275,6 +319,18 @@ class HaloExchange:
             )
             cache[iters] = jax.jit(fn, donate_argnums=0)
         return cache[iters]
+
+    def collective_census(self, state) -> Dict[str, Tuple[int, int]]:
+        """``{op kind: (count, bytes)}`` of ONE compiled exchange of
+        ``state`` — the per-method data-movement census the bench_mpi_pack
+        ablation tables row out (see utils/hlo_check.collective_census).
+        Static counts over the post-SPMD-partitioning HLO: what each
+        strategy actually asks the interconnect to move, counted the same
+        way for hand-written ppermutes and partitioner-synthesized ones."""
+        from ..utils.hlo_check import collective_census
+
+        txt = self._compiled.lower(state).compile().as_text()
+        return collective_census(txt)
 
     def bytes_logical(self, itemsizes: Sequence[int]) -> int:
         """Total halo bytes delivered per exchange (reference-parity count)."""
@@ -289,10 +345,29 @@ class HaloExchange:
         self-wrap (single-block) axis no collective carries data — the same
         slab bytes move in place, via the Pallas fill kernel on TPU (whose
         x/y lane/row-tile RMW amplification is not counted here) or via
-        slice+update elsewhere."""
+        slice+update elsewhere. AUTO_SPMD expresses the composed slab
+        program, so it shares the composed accounting (the partitioner may
+        move less; collective_census counts what it actually emitted).
+        Uneven DIRECT26 pads orthogonal extents to the base block size."""
         p = self.spec.padded()
         if self.method == Method.DIRECT26:
-            return self.bytes_logical(itemsizes)
+            if self.spec.is_uniform():
+                return self.bytes_logical(itemsizes)
+            r = self.spec.radius
+            b = self.spec.base
+            total = 0
+            for d in DIRECTIONS_26:
+                if r.dir(-d) == 0:
+                    continue
+                ext = 1
+                for dc, rm, rp, base in (
+                    (d.z, r.z(-1), r.z(1), b.z),
+                    (d.y, r.y(-1), r.y(1), b.y),
+                    (d.x, r.x(-1), r.x(1), b.x),
+                ):
+                    ext *= rm if dc == 1 else rp if dc == -1 else base
+                total += ext
+            return total * sum(itemsizes) * self.spec.num_blocks()
         per_item = 0
         r = self.spec.radius
         per_item += (r.x(-1) + r.x(1)) * p.y * p.z  # x phase
@@ -448,8 +523,79 @@ class HaloExchange:
                               j, off + sz[j])
         return block
 
+    # -- auto-SPMD implementation -------------------------------------------
+    def auto_fill(self, arr):
+        """One halo exchange of a stacked GLOBAL array, with no explicit
+        collectives: each axis phase slices the send extents and shifts them
+        one step along the (sharded) block dim with ``jnp.roll`` — the SPMD
+        partitioner decides what actually moves (shard-internal shifts
+        become local copies, shard-boundary shifts become
+        collective-permutes). Phase order and extents match
+        :meth:`_composed_blocks` exactly, so the result is bit-identical to
+        AXIS_COMPOSED; corner/edge halos compose across phases the same way.
+
+        Called under ``jax.jit`` on ``P('z','y','x')``-sharded arrays (see
+        :attr:`_compiled`); also safe to trace inside larger global jitted
+        steps (ops/jacobi.py's AUTO_SPMD path)."""
+        for name, adim, _ in _AXES:
+            arr = self._auto_axis_phase(arr, name, adim)
+        return arr
+
+    def _auto_axis_phase(self, arr, name: str, adim: int):
+        sizes, rm, rp, off = _spec_axis(self.spec, name)
+        if rm == 0 and rp == 0:
+            return arr
+        bdim = _BDIM[name]
+        n = len(sizes)
+        if len(set(sizes)) == 1:
+            sz = sizes[0]
+            if rm > 0:
+                # every block's top rm planes -> its +neighbor's low halo:
+                # globally, a roll of the slab one step up the block dim
+                slab = lax.slice_in_dim(arr, off + sz - rm, off + sz, axis=adim)
+                slab = jnp.roll(slab, 1, axis=bdim)
+                arr = _update_in_dim(arr, slab, off - rm, adim)
+            if rp > 0:
+                slab = lax.slice_in_dim(arr, off, off + rp, axis=adim)
+                slab = jnp.roll(slab, -1, axis=bdim)
+                arr = _update_in_dim(arr, slab, off + sz, adim)
+            return arr
+        # uneven axis: per-block source/dest offsets. The source gather and
+        # the dest blend are elementwise along (block dim x data dim) pairs,
+        # so the partitioner still sees exactly one cross-block movement per
+        # side — the roll.
+        ndim = arr.ndim
+        bshape = [1] * ndim
+        bshape[bdim] = n
+        sz_b = jnp.asarray(sizes, jnp.int32).reshape(bshape)
+        if rm > 0:
+            # block i sends [off + sizes[i] - rm, off + sizes[i]); the
+            # receiver's low-side halo sits at the static [off - rm, off)
+            ashape = [1] * ndim
+            ashape[adim] = rm
+            gidx = sz_b + (off - rm) + jnp.arange(rm, dtype=jnp.int32).reshape(ashape)
+            slab = jnp.take_along_axis(arr, gidx, axis=adim)
+            slab = jnp.roll(slab, 1, axis=bdim)
+            arr = _update_in_dim(arr, slab, off - rm, adim)
+        if rp > 0:
+            # the sender side is static ([off, off + rp), the compute
+            # origin); the receiver's high-side halo starts at the
+            # per-block off + sizes[i] — a masked blend places it
+            slab = lax.slice_in_dim(arr, off, off + rp, axis=adim)
+            slab = jnp.roll(slab, -1, axis=bdim)
+            ashape = [1] * ndim
+            ashape[adim] = arr.shape[adim]
+            rel = jnp.arange(arr.shape[adim], dtype=jnp.int32).reshape(ashape) - (
+                sz_b + off
+            )
+            vals = jnp.take_along_axis(slab, jnp.clip(rel, 0, rp - 1), axis=adim)
+            arr = jnp.where((rel >= 0) & (rel < rp), vals, arr)
+        return arr
+
     # -- direct-26 implementation -------------------------------------------
     def _direct26_blocks(self, block):
+        if not self.spec.is_uniform():
+            return self._direct26_blocks_uneven(block)
         spec = self.spec
         sz = spec.base  # uniform
         r = spec.radius
@@ -496,6 +642,80 @@ class HaloExchange:
             updates.append((slab, dsts))
         for slab, dsts in updates:
             block = lax.dynamic_update_slice(block, slab, (0, 0, 0) + tuple(dsts))
+        return block
+
+    def _direct26_blocks_uneven(self, block):
+        """DIRECT26 on a remainder (uneven) partition: the same 26 messages,
+        with slab extents padded to the base block size along each
+        direction's orthogonal (zero-component) axes — every ``ppermute``
+        participant needs ONE static shape, and blocks in the same ring
+        share their orthogonal-axis sizes (grid.py), so the valid slab
+        region always aligns sender→receiver. Messages apply in
+        face→edge→corner order: a padded write can spill only into a
+        band belonging to a direction with MORE nonzero components (or into
+        dead pad), so every halo cell's true message lands last. Per-block
+        compute extents come from traced lookups into the static per-axis
+        size tables — the same machinery as :meth:`_axis_phase_resident`
+        (VERDICT r5 "Next" #5; ROADMAP #4)."""
+        spec = self.spec
+        r = spec.radius
+        off = spec.compute_offset()
+        base = spec.base
+        cz, cy, cx = self.resident.z, self.resident.y, self.resident.x
+        sz = {
+            AXIS_Z: self._resident_sizes(AXIS_Z, cz),
+            AXIS_Y: self._resident_sizes(AXIS_Y, cy),
+            AXIS_X: self._resident_sizes(AXIS_X, cx),
+        }
+        dirs = [d for d in DIRECTIONS_26 if r.dir(-d) != 0]
+        dirs.sort(key=lambda d: abs(d.x) + abs(d.y) + abs(d.z))
+        for d in dirs:
+            # per-axis (component, compute offset, r-, r+, base) in z,y,x order
+            info = tuple(zip(
+                (d.z, d.y, d.x),
+                (off.z, off.y, off.x),
+                (r.z(-1), r.y(-1), r.x(-1)),
+                (r.z(1), r.y(1), r.x(1)),
+                (base.z, base.y, base.x),
+            ))
+            shape = tuple(
+                rm if dc == 1 else rp if dc == -1 else b
+                for dc, _o, rm, rp, b in info
+            )
+            if any(e == 0 for e in shape):
+                continue
+            parts_z = []
+            for jz in range(cz):
+                parts_y = []
+                for jy in range(cy):
+                    parts_x = []
+                    for jx in range(cx):
+                        s3 = (sz[AXIS_Z][jz], sz[AXIS_Y][jy], sz[AXIS_X][jx])
+                        src = tuple(
+                            o + s - rm if dc == 1 else o
+                            for (dc, o, rm, _rp, _b), s in zip(info, s3)
+                        )
+                        parts_x.append(lax.dynamic_slice(
+                            block, _starts6((jz, jy, jx), src), (1, 1, 1) + shape
+                        ))
+                    parts_y.append(_concat(parts_x, 2))
+                parts_z.append(_concat(parts_y, 1))
+            slab = self._roll_blocks(_concat(parts_z, 0), d)
+            for jz in range(cz):
+                for jy in range(cy):
+                    for jx in range(cx):
+                        s3 = (sz[AXIS_Z][jz], sz[AXIS_Y][jy], sz[AXIS_X][jx])
+                        dst = tuple(
+                            o - rm if dc == 1 else o + s if dc == -1 else o
+                            for (dc, o, rm, _rp, _b), s in zip(info, s3)
+                        )
+                        piece = lax.dynamic_slice(
+                            slab, _starts6((jz, jy, jx), (0, 0, 0)),
+                            (1, 1, 1) + shape,
+                        )
+                        block = lax.dynamic_update_slice(
+                            block, piece, _starts6((jz, jy, jx), dst)
+                        )
         return block
 
     def _roll_blocks(self, slab, d: Dim3):
@@ -556,6 +776,17 @@ def _starts(ndim: int, start, adim: int):
     s = [jnp.asarray(0, jnp.int32)] * ndim
     s[adim] = jnp.asarray(start, jnp.int32)
     return tuple(s)
+
+
+def _starts6(bidx, data_starts):
+    """Start indices of one resident block's slab in the stacked layout:
+    (jz, jy, jx) block dims + (z, y, x) data starts, uniformly int32
+    (data starts may be traced size-table lookups)."""
+    return tuple(jnp.asarray(v, jnp.int32) for v in (*bidx, *data_starts))
+
+
+def _concat(parts, axis: int):
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=axis)
 
 
 def _slice_in_dim(block, start, width: int, adim: int):

@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..geometry import Dim3
 
@@ -27,6 +27,19 @@ AXIS_Y = "y"
 AXIS_Z = "z"
 # Mesh/array-major order: z slowest, x fastest.
 MESH_AXES = (AXIS_Z, AXIS_Y, AXIS_X)
+
+# The one PartitionSpec of the stacked-block layout (bz, by, bx, pz, py, px):
+# block-grid dims sharded over the mesh, data dims replicated. It lives here
+# (not in exchange.py) because it is a fact of the mesh-axis naming, shared
+# by the manual shard_map exchanges AND the AUTO_SPMD jit programs whose
+# collectives the SPMD partitioner synthesizes from this sharding.
+BLOCK_PSPEC = P(AXIS_Z, AXIS_Y, AXIS_X, None, None, None)
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding of the stacked-block layout over a grid mesh — the
+    in/out sharding every exchange strategy and jitted step pins."""
+    return NamedSharding(mesh, BLOCK_PSPEC)
 
 
 def grid_mesh(dim, devices: Optional[Sequence] = None, ordered: bool = False) -> Mesh:

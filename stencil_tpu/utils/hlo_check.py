@@ -138,6 +138,88 @@ def overlap_report(mlir_text: str, kernel_marker: str = "tpu_custom_call") -> di
     }
 
 
+# -- collective census (bench_mpi_pack ablation accounting) ------------------
+
+# HLO element sizes in bytes for the dtypes this framework traffics in.
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+COLLECTIVE_KINDS = (
+    "collective-permute",
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "reduce-scatter",
+    "collective-broadcast",
+)
+
+# `KIND(` right after the result type(s): matches both sync ops and the
+# `-start` half of async pairs (`-done` consumes no extra interconnect).
+_COLLECTIVE_OP_RE = re.compile(
+    r"=\s*[^=]*?\b(" + "|".join(COLLECTIVE_KINDS) + r")(-start)?\("
+)
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([0-9,]*)\]")
+_PAIR_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+_GROUPS_RE = re.compile(r"replica_groups=\{((?:\{[\d,]*\},?)*)\}")
+
+
+def _tensor_bytes(dtype: str, dims: str) -> int:
+    n = _DTYPE_BYTES.get(dtype, 0)
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def collective_census(hlo_text: str) -> Dict[str, Tuple[int, int]]:
+    """``{op kind: (count, bytes)}`` over a compiled (post-SPMD-partitioning)
+    HLO module — the per-method data-movement accounting of the
+    bench_mpi_pack ablation (reference: bin/bench_mpi_pack.cu:18-80).
+
+    Scans every computation in the module (while-loop bodies and called
+    computations included — the callee-aware discipline of
+    :func:`_main_body`), so shard_map-lowered hand-written ppermutes and
+    partitioner-synthesized collectives are counted identically. Counts are
+    STATIC op instances: an op inside a fori_loop body counts once, so
+    census a single-exchange program, not a fused loop, when comparing
+    strategies.
+
+    Bytes are the interconnect payload per op instance, summed per kind:
+    the operand buffer is the per-shard payload; for ``collective-permute``
+    it is multiplied by the number of ``source_target_pairs`` (each pair
+    carries one payload across a link — the exact figure the ablation
+    table wants); for gather/reduce/all-to-all kinds it is multiplied by
+    the participant count in ``replica_groups`` (a first-order upper bound
+    for ring/tree implementations). Async ``-start``/``-done`` pairs count
+    once, at the start op."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for ln in hlo_text.splitlines():
+        m = _COLLECTIVE_OP_RE.search(ln)
+        if not m:
+            continue
+        kind = m.group(1)
+        # operand types sit between `KIND(` and the first `)` (shapes never
+        # contain parens in HLO text)
+        args = ln[m.end():].split(")", 1)[0]
+        payload = sum(_tensor_bytes(d, dims) for d, dims in _SHAPE_RE.findall(args))
+        pm = _PAIR_RE.search(ln)
+        if kind == "collective-permute" and pm:
+            fanout = pm.group(1).count("{")
+        else:
+            gm = _GROUPS_RE.search(ln)
+            fanout = (
+                sum(1 for t in re.split(r"[{},]", gm.group(1)) if t) if gm else 1
+            )
+        count, nbytes = out.get(kind, (0, 0))
+        out[kind] = (count + 1, nbytes + payload * max(1, fanout))
+    return out
+
+
 def assert_overlap_independent(mlir_text: str, expect_permutes: int = None) -> dict:
     """Raise AssertionError unless the permutes and the kernel are mutually
     independent (the overlap-enabling dataflow)."""

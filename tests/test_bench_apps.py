@@ -53,12 +53,26 @@ def test_bench_exchange_sweep():
 
 
 def test_bench_exchange_method_ablation():
-    rows = bench_exchange.compare_methods(16, 16, 16, iters=2, devices=jax.devices()[:8])
+    rows, agree = bench_exchange.ablate(16, 16, 16, iters=2, devices=jax.devices()[:8])
     assert [r["config"].split("method=")[1] for r in rows] == [
-        "axis-composed", "direct26",
+        "axis-composed", "direct26", "auto-spmd",
     ]
     # identical logical bytes — only the movement strategy differs
-    assert rows[0]["bytes"] == rows[1]["bytes"] > 0
+    assert rows[0]["bytes"] == rows[1]["bytes"] == rows[2]["bytes"] > 0
+    # the CI gate: all three strategies deliver bit-identical halos
+    assert agree
+    # census columns: composed 6 hand-written permutes and direct26 one per
+    # direction — per quantity (the harness exchanges 4) — auto >= 1
+    # synthesized permute and nothing else
+    by = {r["config"].split("method=")[1]: r for r in rows}
+    assert by["axis-composed"]["cp_count"] == 6 * 4
+    assert by["direct26"]["cp_count"] == 26 * 4
+    assert by["auto-spmd"]["cp_count"] >= 1
+    assert all(r["other_collectives"] == 0 for r in rows)
+    assert all(r["cp_bytes"] > 0 for r in rows)
+    # the ablation CSV has the census columns
+    assert bench_exchange.ablate_row(rows[0]).count(",") == \
+        bench_exchange.ablate_header().count(",")
 
 
 def test_bench_pack_rows():
