@@ -3,11 +3,15 @@
 size, bin/jacobi3d.cu:100-102) plus halo-exchange GB/s and the astaroth
 flagship details, printed as ONE JSON line with rc=0 — always.
 
-Architecture (round-4 hardening): the PARENT process never initializes a
-JAX backend. The tunneled TPU plugin can stall ``jax.devices()``
+Architecture (round-4 hardening, refactored onto the obs/ watchdog): the
+PARENT process never initializes a JAX backend — it does not even import
+the ``stencil_tpu`` package (whose ``__init__`` imports jax); the revival
+watcher, ``stencil_tpu/obs/watchdog.py``, is pure stdlib and loaded by
+FILE PATH. The tunneled TPU plugin can stall ``jax.devices()``
 indefinitely or die mid-``device_put`` (round-3 BENCH artifact, rc=1), so
-all measurement runs in CHILD subprocesses the parent can time out and
-retry:
+all measurement runs in CHILD subprocesses supervised on two layered
+deadlines (total budget + telemetry heartbeat staleness — a wedged child
+is killed as a STALL long before the budget):
 
   1. accelerator child (whatever backend JAX finds — the driver's TPU chip),
      retried once with backoff;
@@ -15,6 +19,12 @@ retry:
      backend init — the env-var spelling is ignored once the tunnel plugin
      registers) with small sizes;
   3. a last-resort static JSON line if even the CPU child fails.
+
+Children emit heartbeats through stencil_tpu.obs.telemetry (a background
+beat thread plus per-leg beats); set STENCIL_BENCH_LOG_DIR to archive
+per-attempt child logs, STENCIL_BENCH_HEARTBEAT_S to tune the stall
+deadline, and STENCIL_BENCH_METRICS_OUT to also get the children's
+metrics JSONL (same schema as the apps' --metrics-out).
 
 vs_baseline for the headline compares against this repo's recorded ROUND-1
 TPU number (the reference publishes no absolute numbers — BASELINE.md §1),
@@ -29,9 +39,7 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
-import tempfile
 import time
 
 # Recorded TPU v5e single-chip numbers (BASELINE.md "Recorded numbers").
@@ -61,12 +69,23 @@ def _child_main(mode: str) -> int:
         # sitecustomize pins JAX_PLATFORMS and the plugin ignores the env var
         jax.config.update("jax_platforms", "cpu")
 
+    # telemetry: heartbeats for the supervising watchdog (no-op unsupervised)
+    # + optional metrics JSONL; configure BEFORE any backend init so a
+    # wedged init is already covered by the beat thread
+    from stencil_tpu.obs import telemetry
+
+    rec = telemetry.configure(
+        metrics_out=os.environ.get("STENCIL_BENCH_METRICS_OUT") or None,
+        app="bench",
+    )
+
     budget_s = float(os.environ.get("STENCIL_BENCH_LEG_BUDGET_S", "840"))
     t0 = time.time()
     errors: dict[str, str] = {}
 
     def leg(name: str) -> bool:
         left = budget_s - (time.time() - t0)
+        rec.heartbeat()
         print(
             f"[bench:{mode}] {name}: {time.time()-t0:.0f}s elapsed, "
             f"{left:.0f}s budget left",
@@ -233,29 +252,28 @@ def _child_main(mode: str) -> int:
 # --------------------------------------------------------------- parent side
 
 
-def _run_child(mode: str, timeout_s: float) -> dict | None:
-    """Run one measurement child; return its JSON payload or None.
+def _load_watchdog():
+    """Load stencil_tpu/obs/watchdog.py by FILE PATH.
 
-    stdout/stderr go to temp files (the tunneled platform's partial output
-    dies in pipes when the child is killed on timeout)."""
-    cmd = [sys.executable, os.path.abspath(__file__), "--child", mode]
-    env = dict(os.environ)
-    env["STENCIL_BENCH_LEG_BUDGET_S"] = str(max(60.0, timeout_s - 60.0))
-    with tempfile.TemporaryFile(mode="w+") as out, \
-            tempfile.TemporaryFile(mode="w+") as err:
-        try:
-            proc = subprocess.run(
-                cmd, stdout=out, stderr=err, env=env, timeout=timeout_s
-            )
-            rc = proc.returncode
-        except subprocess.TimeoutExpired:
-            rc = -1
-            print(f"[bench] {mode} child timed out after {timeout_s:.0f}s",
-                  file=sys.stderr, flush=True)
-        out.seek(0)
-        err.seek(0)
-        stdout = out.read()
-        stderr_tail = err.read()[-2000:]
+    The parent must never import the ``stencil_tpu`` package: its
+    ``__init__`` imports jax, and the wedge being supervised lives in JAX
+    backend/plugin machinery. watchdog.py is pure stdlib by contract."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "stencil_tpu", "obs", "watchdog.py",
+    )
+    spec = importlib.util.spec_from_file_location("stencil_watchdog", path)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: dataclasses resolves string annotations through
+    # sys.modules[cls.__module__]
+    sys.modules["stencil_watchdog"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _parse_sentinel(stdout: str) -> dict | None:
     payload = None
     for line in stdout.splitlines():
         if line.startswith(SENTINEL):
@@ -263,18 +281,33 @@ def _run_child(mode: str, timeout_s: float) -> dict | None:
                 payload = json.loads(line[len(SENTINEL):])
             except json.JSONDecodeError:
                 payload = None
-    if payload is None:
-        print(f"[bench] {mode} child produced no result (rc={rc});"
-              f" stderr tail:\n{stderr_tail}", file=sys.stderr, flush=True)
     return payload
 
 
 def main() -> int:
+    watchdog = _load_watchdog()
     budget_s = float(os.environ.get("STENCIL_BENCH_BUDGET_S", "900"))
-    t0 = time.time()
+    # stall deadline: generous — a leg can sit in a single XLA compile for
+    # minutes, and a compile that holds the interpreter also pauses the
+    # child's beat thread (that pause must not read as a wedge)
+    heartbeat_s = float(os.environ.get("STENCIL_BENCH_HEARTBEAT_S", "300"))
+    rev = watchdog.Revival(
+        budget_s=budget_s,
+        parse=_parse_sentinel,
+        archive_dir=os.environ.get("STENCIL_BENCH_LOG_DIR") or None,
+    )
 
-    def remaining() -> float:
-        return budget_s - (time.time() - t0)
+    def child(mode: str, timeout_s: float, floor_s: float = 0.0):
+        env = dict(os.environ)
+        env["STENCIL_BENCH_LEG_BUDGET_S"] = str(max(60.0, timeout_s - 60.0))
+        return rev.attempt(
+            f"bench-{mode}",
+            [sys.executable, os.path.abspath(__file__), "--child", mode],
+            timeout_s=timeout_s,
+            heartbeat_timeout_s=heartbeat_s,
+            env=env,
+            floor_timeout_s=floor_s,
+        )
 
     # schedule: accel try 1 (bulk of the budget), backoff, accel try 2,
     # forced-CPU fallback (reserved slice), static last resort. Every
@@ -289,19 +322,22 @@ def main() -> int:
     plan = [("accel", avail * 0.85), ("accel", avail * 0.15)]
     for i, (mode, timeout_s) in enumerate(plan):
         if i > 0:
-            time.sleep(min(20.0, max(0.0, remaining() - reserve_cpu) / 4))
-        timeout_s = min(timeout_s, max(10.0, remaining() - reserve_cpu))
+            rev.backoff(20.0, floor_s=reserve_cpu)
+        timeout_s = min(timeout_s, max(10.0, rev.remaining() - reserve_cpu))
         if timeout_s < 10.0:
             continue  # not enough time to even import jax
-        payload = _run_child(mode, timeout_s)
+        payload = child(mode, timeout_s)
         if payload is not None:
             print(json.dumps(payload), flush=True)
             return 0
-    payload = _run_child("cpu", max(30.0, remaining() - 5.0))
+    payload = child("cpu", max(30.0, rev.remaining() - 5.0), floor_s=30.0)
     if payload is not None:
         print(json.dumps(payload), flush=True)
         return 0
-    # last resort: the driver still gets its one line and rc=0
+    # last resort: the driver still gets its one line and rc=0; the
+    # attempt ladder (outcomes, archived logs) goes to stderr as evidence
+    print(f"[bench] all children failed; attempts: "
+          f"{json.dumps(rev.report())}", file=sys.stderr, flush=True)
     print(
         json.dumps(
             {

@@ -5,6 +5,7 @@ loop (reference: bin/exchange_weak.cu:140-196)."""
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional, Sequence
 
@@ -13,9 +14,45 @@ import jax.numpy as jnp
 
 from ..api import DistributedDomain
 from ..geometry import Dim3, Radius
+from ..obs import telemetry
 from ..parallel import IntraNodeRandom, Method, NodeAware, Trivial
 from ..utils.statistics import Statistics
 from ..utils.sync import hard_sync
+
+
+def add_metrics_flags(p, dma: bool = False) -> None:
+    """The flight-recorder flags every bench app shares; ``dma=True`` adds
+    the static-DMA-truth opt-in for apps with a Pallas fast path."""
+    p.add_argument(
+        "--metrics-out",
+        default=os.environ.get("STENCIL_METRICS_OUT", ""),
+        help="append telemetry records (one JSON object per line; schema "
+             "stencil_tpu/obs/telemetry.py, aggregated by apps/report.py) "
+             "to this file",
+    )
+    p.add_argument("--run-id", default="",
+                   help="telemetry run id (default: generated)")
+    if dma:
+        p.add_argument(
+            "--metrics-dma", action="store_true",
+            help="also record the compiled Mosaic kernels' static per-pass "
+                 "DMA bytes (a full TPU lowering; needs the Pallas fast "
+                 "path)",
+        )
+
+
+def start_metrics(args, app: str) -> "telemetry.Recorder":
+    """Install the process-default recorder from parsed flags.
+
+    The run's argv config rides along as the first meta record, so a
+    metrics file is self-describing. Apps call this AFTER any --cpu
+    backend configuration (recording must never pin the platform)."""
+    return telemetry.configure(
+        metrics_out=getattr(args, "metrics_out", "") or None,
+        app=app,
+        run_id=getattr(args, "run_id", "") or None,
+        config=vars(args),
+    )
 
 
 def coord_state(dd, quantities: int):
@@ -76,6 +113,8 @@ def time_exchange(
         dd.add_data(f"d{i}", dtype)
     dd.realize()
 
+    rec = telemetry.get()
+    itemsizes = [jnp.dtype(dtype).itemsize] * quantities
     state = dd.curr_state()
     chunk = max(1, min(chunk, iters))
     tail = iters % chunk
@@ -83,9 +122,17 @@ def time_exchange(
     if tail:
         loops[tail] = dd.halo_exchange.make_loop(tail)
     # compile + warm every loop size OUTSIDE the timed region
-    for fn in loops.values():
-        state = fn(state)
-    hard_sync(state)
+    with rec.span("exchange.warmup", phase="compile", method=method.value):
+        for fn in loops.values():
+            state = fn(state)
+        hard_sync(state)
+    census = None
+    if rec.enabled:
+        # compile-time truth: census the compiled single-exchange program
+        # (exact on-wire volume) alongside the measured times below; the
+        # census rides the result so callers (ablate) never recompile it
+        census = telemetry.record_exchange_truth(
+            dd.halo_exchange, state, itemsizes)
 
     stats = Statistics()
     done = 0
@@ -94,12 +141,23 @@ def time_exchange(
         t0 = time.perf_counter()
         state = loops[k](state)
         hard_sync(state)
-        stats.insert((time.perf_counter() - t0) / k)
+        per = (time.perf_counter() - t0) / k
+        stats.insert(per)
+        rec.emit("span", "exchange.iter", phase="exchange", seconds=per,
+                 iters=k, method=method.value)
         done += k
     dd._curr = dict(state)  # the loops donated the original buffers
-    itemsizes = [jnp.dtype(dtype).itemsize] * quantities
+    if rec.enabled:
+        rec.gauge("exchange.trimean_s", stats.trimean(), phase="exchange",
+                  unit="s", method=method.value)
+        rec.gauge(
+            "exchange.gb_per_s",
+            dd.halo_exchange.bytes_logical(itemsizes) / stats.trimean() / 1e9,
+            phase="exchange", method=method.value,
+        )
     return {
         "domain": dd,
+        "census": census,
         "stats": stats,
         "trimean_s": stats.trimean(),
         "min_s": stats.min(),

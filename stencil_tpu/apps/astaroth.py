@@ -32,6 +32,7 @@ from ..astaroth.init import const_init, hash_init, radial_explosion_init
 from ..astaroth.integrate import FIELDS, make_astaroth_step, uses_pallas
 from ..astaroth.reductions import Reductions
 from ..geometry import Dim3, Radius, prime_factors
+from ..obs import telemetry
 from ..parallel import Method
 from ..apps._bench_common import placement_from_flags
 from ..utils import timer
@@ -74,6 +75,7 @@ def run(
     use_pallas=None,
     chunk: int = 1,
     kernel_variant: Optional[str] = None,
+    metrics_dma: bool = False,
 ) -> dict:
     """Run ``iters`` iterations (plus one untimed warmup chunk) and return
     timing stats + the domain.
@@ -149,20 +151,22 @@ def run(
 
     # init (reference: astaroth.cu:493-520): hash-random everything,
     # constant 0.5 lnrho, radial-explosion velocity
+    rec = telemetry.get()
     np_dtype = np.dtype(dtype)
-    ds = (
-        info.real_params["AC_dsx"],
-        info.real_params["AC_dsy"],
-        info.real_params["AC_dsz"],
-    )
-    h = hash_init(size, dtype=np_dtype)  # coordinate-determined, same per field
-    for name in ("entropy", "ax", "ay", "az"):
-        dd.set_curr_global(handles[name], h)
-    dd.set_curr_global(handles["lnrho"], const_init(size, 0.5, dtype=np_dtype))
-    uux, uuy, uuz = radial_explosion_init(size, ds=ds, dtype=np_dtype)
-    dd.set_curr_global(handles["uux"], uux)
-    dd.set_curr_global(handles["uuy"], uuy)
-    dd.set_curr_global(handles["uuz"], uuz)
+    with rec.span("astaroth.init", phase="init"):
+        ds = (
+            info.real_params["AC_dsx"],
+            info.real_params["AC_dsy"],
+            info.real_params["AC_dsz"],
+        )
+        h = hash_init(size, dtype=np_dtype)  # coordinate-determined, same per field
+        for name in ("entropy", "ax", "ay", "az"):
+            dd.set_curr_global(handles[name], h)
+        dd.set_curr_global(handles["lnrho"], const_init(size, 0.5, dtype=np_dtype))
+        uux, uuy, uuz = radial_explosion_init(size, ds=ds, dtype=np_dtype)
+        dd.set_curr_global(handles["uux"], uux)
+        dd.set_curr_global(handles["uuy"], uuy)
+        dd.set_curr_global(handles["uuz"], uuz)
 
     if paraview_init:
         dd.write_paraview("init")
@@ -175,8 +179,9 @@ def run(
     if no_compute:
         # measure pure exchange per substep (reference --no-compute flag)
         loop = dd.halo_exchange.make_loop(3)
-        curr = loop(curr)
-        hard_sync(curr)
+        with rec.span("astaroth.warmup", phase="compile"):
+            curr = loop(curr)
+            hard_sync(curr)
         for _ in range(iters):
             t0 = time.perf_counter()
             curr = loop(curr)
@@ -184,6 +189,8 @@ def run(
             dt_iter = time.perf_counter() - t0
             iter_time.insert(dt_iter)
             exch_time.insert(dt_iter)
+            rec.emit("span", "astaroth.exchange", phase="exchange",
+                     seconds=dt_iter, iters=3)
     else:
         chunk = max(1, min(chunk, iters))
         step = make_astaroth_step(
@@ -197,8 +204,9 @@ def run(
             iters=chunk,
             kernel_variant=kernel_variant,
         )
-        curr, nxt = step(curr, nxt)  # compile + warm (one chunk)
-        hard_sync(curr)
+        with rec.span("astaroth.warmup", phase="compile", iters=chunk):
+            curr, nxt = step(curr, nxt)  # compile + warm (one chunk)
+            hard_sync(curr)
         # The exchange share can't be timed inside the fused step, so it is
         # measured as a standalone loop on the same state each iteration
         # (halo exchange is idempotent on exchanged data, so this does not
@@ -220,11 +228,41 @@ def run(
             per = (time.perf_counter() - t0) / chunk
             for _ in range(chunk):
                 iter_time.insert(per)
+            rec.emit("span", "astaroth.iter", phase="step", seconds=per,
+                     iters=chunk)
             done += chunk
             t0 = time.perf_counter()
             curr = exch_loop(curr)
             hard_sync(curr)
-            exch_time.insert(time.perf_counter() - t0)
+            ex_dt = time.perf_counter() - t0
+            exch_time.insert(ex_dt)
+            rec.emit("span", "astaroth.exchange", phase="exchange",
+                     seconds=ex_dt, iters=n_ex)
+
+    if rec.enabled:
+        # compile-time truth of this method's exchange (on-wire volume)
+        telemetry.record_exchange_truth(
+            dd.halo_exchange, dict(curr), [np_dtype.itemsize] * len(FIELDS))
+        if metrics_dma and not no_compute:
+            if uses_pallas(dd.halo_exchange, use_pallas, dtype):
+                telemetry.record_dma_traffic(
+                    lambda: (
+                        make_astaroth_step(
+                            dd.halo_exchange, info, dt=dt, overlap=overlap,
+                            swap_per_substep=swap_per_substep,
+                            use_pallas=use_pallas, dtype=dtype, iters=chunk,
+                            kernel_variant=kernel_variant,
+                        ),
+                        (curr, nxt),
+                    ),
+                )
+            else:
+                rec.meta("dma.skipped",
+                         reason="pallas fused substep not engaged")
+        rec.gauge("astaroth.iter_trimean_s", iter_time.trimean(),
+                  phase="step", unit="s")
+        rec.gauge("astaroth.exch_trimean_s", exch_time.trimean(),
+                  phase="exchange", unit="s")
 
     for name in FIELDS:
         dd.set_curr(handles[name], curr[name])
@@ -298,10 +336,13 @@ def main(argv: Optional[list] = None) -> int:
                    help="iterations fused per dispatch (benchmarking; a "
                         "final partial chunk still runs a full chunk)")
     p.add_argument("--cpu", type=int, default=0)
+    from ._bench_common import add_metrics_flags, start_metrics
+    add_metrics_flags(p, dma=True)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    rec = start_metrics(args, "astaroth")
     # dtype default: the reference's double on CPU, float32 on TPU (f64 is
     # software-emulated on TPU; it works through the serialized XLA path —
     # run() forces overlap off there — but is ~20x slower than fp32)
@@ -327,9 +368,13 @@ def main(argv: Optional[list] = None) -> int:
         use_pallas=False if args.no_pallas else None,
         chunk=args.chunk,
         kernel_variant=args.kernel_variant,
+        metrics_dma=args.metrics_dma and rec.enabled,
     )
     print(csv_row(r))
     log.info(timer.report())
+    if rec.enabled:
+        rec.record_timer_buckets()
+        rec.close()
     if "reductions" in r:
         for k, v in r["reductions"].items():
             log.info(f"{k}: {v}")

@@ -28,8 +28,11 @@ import jax
 import numpy as np
 
 from ..geometry import Dim3, Radius
+from ..obs import telemetry
 from ..parallel import Method
-from ._bench_common import coord_state, time_exchange
+from ._bench_common import (
+    add_metrics_flags, coord_state, start_metrics, time_exchange,
+)
 
 # ablation order: manual composed, manual direct, partitioner-synthesized
 ABLATE_METHODS = (Method.AXIS_COMPOSED, Method.DIRECT26, Method.AUTO_SPMD)
@@ -105,6 +108,7 @@ def compare_methods(x, y, z, iters=30, quantities=4, devices=None, radius=2,
                 "trimean_s": r["trimean_s"],
                 "bytes_per_s": r["bytes_logical"] / r["trimean_s"],
                 "domain": r["domain"],
+                "census": r["census"],
             }
         )
     return rows
@@ -125,14 +129,21 @@ def ablate(x, y, z, iters=30, quantities=4, devices=None, radius=2):
         x, y, z, iters=iters, quantities=quantities, devices=devices,
         radius=radius,
     )
+    rec = telemetry.get()
     outs = {}
     for row in rows:
         dd = row.pop("domain")
         ex = dd.halo_exchange
         state = coord_state(dd, quantities)
-        # census first: it only lowers/compiles, so the same state then
-        # feeds (and is donated to) the agreement exchange
-        census = ex.collective_census(state)
+        # the census is a STATIC truth (shapes + method, not values), so a
+        # metrics-enabled run reuses the one time_exchange already compiled
+        # and recorded; otherwise lower/compile it here — the same state
+        # then feeds (and is donated to) the agreement exchange
+        census = row.pop("census", None)
+        if census is None:
+            census = ex.collective_census(state)
+            if rec.enabled:
+                telemetry.record_census(census, rec, method=ex.method.value)
         cp = census.get("collective-permute", (0, 0))
         row["cp_count"] = cp[0]
         row["cp_bytes"] = cp[1]
@@ -145,6 +156,8 @@ def ablate(x, y, z, iters=30, quantities=4, devices=None, radius=2):
         )
     vals = list(outs.values())
     agree = all(np.array_equal(vals[0], v) for v in vals[1:])
+    if rec.enabled:
+        rec.gauge("ablate.bit_for_bit_agreement", int(agree), phase="verify")
     return rows, agree
 
 
@@ -185,10 +198,12 @@ def main(argv: Optional[list] = None) -> int:
                         "census columns and a bit-for-bit agreement gate "
                         "(exit 1 on disagreement)")
     p.add_argument("--cpu", type=int, default=0)
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    start_metrics(args, "bench_exchange")
     if args.ablate:
         rows, agree = ablate(args.x, args.y, args.z, iters=args.iters)
         print(ablate_header())

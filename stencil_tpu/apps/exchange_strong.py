@@ -36,10 +36,13 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--random", action="store_true")
     p.add_argument("--direct26", action="store_true")
     p.add_argument("--cpu", type=int, default=0)
+    from ._bench_common import add_metrics_flags, start_metrics
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    start_metrics(args, "exchange_strong")
     r = run(
         args.x,
         args.y,

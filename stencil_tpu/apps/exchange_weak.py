@@ -19,7 +19,9 @@ import jax
 
 from ..geometry import Dim3
 from ..parallel import Method
-from ._bench_common import placement_from_flags, time_exchange
+from ._bench_common import (
+    add_metrics_flags, placement_from_flags, start_metrics, time_exchange,
+)
 from .jacobi3d import weak_scale
 from ..geometry import Radius
 from ..utils import logging as log
@@ -89,10 +91,12 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--random", action="store_true", help="IntraNodeRandom placement")
     p.add_argument("--direct26", action="store_true")
     p.add_argument("--cpu", type=int, default=0)
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    start_metrics(args, "exchange_weak")
     r = run(
         args.x,
         args.y,

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..api import DistributedDomain
 from ..geometry import Dim3, prime_factors
+from ..obs import telemetry
 from ..ops.jacobi import INIT_TEMP, make_jacobi_loop, make_jacobi_step, sphere_sel
 from ..utils import timer
 from ..parallel import Method
@@ -64,6 +65,7 @@ def run(
     chunk: Optional[int] = None,
     deep_halo: int = 1,
     multistep_rows: Optional[int] = None,
+    metrics_dma: bool = False,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -117,10 +119,12 @@ def run(
     dd.realize()
 
     # init: uniform lukewarm field (reference: bin/jacobi3d.cu:18-27)
-    sharding = dd.sharding()
-    shape = dd.spec.stacked_shape_zyx()
-    dd.set_curr(h, jax.device_put(jnp.full(shape, INIT_TEMP, jnp.float32), sharding))
-    sel = shard_blocks(sphere_sel(size), dd.spec, dd.mesh)
+    rec = telemetry.get()
+    with rec.span("jacobi.init", phase="init"):
+        sharding = dd.sharding()
+        shape = dd.spec.stacked_shape_zyx()
+        dd.set_curr(h, jax.device_put(jnp.full(shape, INIT_TEMP, jnp.float32), sharding))
+        sel = shard_blocks(sphere_sel(size), dd.spec, dd.mesh)
 
     if paraview:
         dd.write_paraview(prefix + "jacobi3d_init")
@@ -150,10 +154,11 @@ def run(
         return loops[k]
 
     loop = get_loop(chunk)
-    for _ in range(warmup):  # compile + warm caches, excluded from timing
-        curr, nxt = loop(curr, nxt, sel)
-    if warmup:
-        hard_sync(curr)
+    with rec.span("jacobi.warmup", phase="compile", iters=warmup * chunk):
+        for _ in range(warmup):  # compile + warm caches, excluded from timing
+            curr, nxt = loop(curr, nxt, sel)
+        if warmup:
+            hard_sync(curr)
 
     # Iterations run in fused chunks: one dispatch + one hard sync per chunk
     # (block_until_ready is unreliable and per-call dispatch is ~0.7 s on the
@@ -169,11 +174,58 @@ def run(
         t0 = time.perf_counter()
         curr, nxt = fn(curr, nxt, sel)
         hard_sync(curr)
-        iter_time.insert((time.perf_counter() - t0) / k)
+        per = (time.perf_counter() - t0) / k
+        iter_time.insert(per)
+        rec.emit("span", "jacobi.iter", phase="step", seconds=per, iters=k)
         done += k
         if stepwise and done % checkpoint_period == 0:
             dd.set_curr(h, curr)
             dd.write_paraview(f"{prefix}jacobi3d_{done}")
+    if rec.enabled:
+        # per-phase split + the compiled programs' static truth. The step
+        # fuses exchange+compute, so the exchange share is measured as a
+        # standalone fused loop on the same state (halo exchange is
+        # idempotent on exchanged data — the astaroth exchElapsed idiom);
+        # the census pins the exact on-wire bytes of one exchange.
+        itemsizes = [jnp.dtype(jnp.float32).itemsize]
+        telemetry.record_exchange_truth(
+            dd.halo_exchange, {h.idx: curr}, itemsizes)
+        n_ex = max(1, min(chunk, 10))
+        exch_loop = dd.halo_exchange.make_loop(n_ex)
+        st = {h.idx: curr}
+        with rec.span("jacobi.exchange_warmup", phase="compile"):
+            st = exch_loop(st)
+            hard_sync(st)
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st = exch_loop(st)
+            hard_sync(st)
+            rec.emit("span", "jacobi.exchange", phase="exchange",
+                     seconds=(time.perf_counter() - t0) / n_ex, iters=n_ex)
+        curr = st[h.idx]
+        if metrics_dma:
+            # static per-kernel HBM DMA truth from the compiled Mosaic
+            # artifact (utils/mosaic_traffic) — only meaningful where the
+            # Pallas fast path engages (a TPU-lowered kernel exists)
+            from ..ops.jacobi import _want_pallas
+
+            if _want_pallas(dd.halo_exchange, None):
+                # rebuild EXACTLY the measured configuration (same temporal
+                # depth pin as get_loop) — the DMA truth must describe the
+                # kernel that actually ran
+                telemetry.record_dma_traffic(
+                    lambda: (
+                        make_jacobi_loop(
+                            dd.halo_exchange, chunk, overlap=overlap,
+                            use_pallas=True,
+                            temporal_k=deep_halo if deep_halo >= 2 else None,
+                            multistep_rows=multistep_rows),
+                        (curr, nxt, sel),
+                    ),
+                )
+            else:
+                rec.meta("dma.skipped",
+                         reason="pallas fast path not engaged")
     dd.set_curr(h, curr)
     dd.set_next(h, nxt)
 
@@ -199,6 +251,13 @@ def run(
         "domain": dd,
         "handle": h,
     }
+    if rec.enabled:
+        rec.gauge("jacobi.mcells_per_s", result["mcells_per_s"], phase="step")
+        rec.gauge("jacobi.mcells_per_s_per_dev",
+                  result["mcells_per_s_per_dev"], phase="step")
+        rec.gauge("jacobi.iter_trimean_s", trimean, phase="step", unit="s")
+        rec.counter("jacobi.exchange_bytes", bytes=result["exchange_bytes"],
+                    phase="exchange", method=method.value)
     return result
 
 
@@ -238,12 +297,15 @@ def main(argv: Optional[list] = None) -> int:
                         "(default: automatic — full planes while they reach "
                         "the depth cap, row-tiled staging beyond; the "
                         "probing knob for the 768^3 depth regime)")
+    from ._bench_common import add_metrics_flags, start_metrics
+    add_metrics_flags(p, dma=True)
     args = p.parse_args(argv)
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         # must happen before backend init to actually create N devices
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    rec = start_metrics(args, "jacobi3d")
 
     r = run(
         args.x,
@@ -260,10 +322,14 @@ def main(argv: Optional[list] = None) -> int:
         prefix=args.prefix,
         deep_halo=args.deep_halo,
         multistep_rows=args.multistep_rows,
+        metrics_dma=args.metrics_dma and rec.enabled,
     )
     print(csv_row(r))
     log.info(f"mcells/s = {r['mcells_per_s']:.1f} ({r['mcells_per_s_per_dev']:.1f}/device)")
     log.info(timer.report())
+    if rec.enabled:
+        rec.record_timer_buckets()
+        rec.close()
     return 0
 
 

@@ -10,18 +10,25 @@ Also prints the default partition the framework would choose for these
 devices (NodePartition hosts x devices-per-host), closing the loop from
 inventory to decomposition.
 
-Usage: python -m stencil_tpu.apps.machine_info [--cpu 8] [--size 256]
+``--json`` emits the same inventory machine-readably — one telemetry
+record per line in the metrics JSONL schema (stencil_tpu/obs/telemetry.py)
+— the analogue of the reference's NVML dump, consumable by the same
+tooling as ``--metrics-out`` files (apps/report.py validates it).
+
+Usage: python -m stencil_tpu.apps.machine_info [--cpu 8] [--size 256] [--json]
 """
 
 from __future__ import annotations
 
 import argparse
+import sys
 from typing import Optional
 
 import jax
 import numpy as np
 
 from ..geometry import Dim3, NodePartition, Radius
+from ..obs import telemetry
 from ..parallel.machine import Machine
 from ..utils import logging as log
 
@@ -58,6 +65,40 @@ def report(r: dict) -> str:
         )
 
 
+def emit_records(r: dict, rec: "telemetry.Recorder") -> list:
+    """The inventory as telemetry records (one JSON object per line in the
+    sink): the machine-readable NVML-dump analogue."""
+    m: Machine = r["machine"]
+    out = [rec.meta(
+        "machine",
+        nodes=m.num_nodes(),
+        processes=m.process_count,
+        devices=len(m.devices),
+        hostnames={str(k): v for k, v in sorted(m.hostnames.items())},
+    )]
+    for d in m.devices:
+        out.append(rec.meta(
+            "machine.device",
+            index=d.index,
+            platform=d.platform,
+            device_kind=d.kind,
+            process=d.process_index,
+            coords=list(d.coords) if d.coords is not None else None,
+            core_on_chip=d.core_on_chip,
+        ))
+    part = r["partition"]
+    out.append(rec.meta(
+        "machine.partition",
+        dim=[part.x, part.y, part.z],
+        size=r["size"],
+    ))
+    out.append(rec.meta("machine.distance_matrix",
+                        matrix=np.asarray(r["dist"]).tolist()))
+    out.append(rec.meta("machine.bandwidth_matrix",
+                        matrix=np.asarray(r["bw"]).tolist()))
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     from ..parallel.distributed import maybe_init_from_env
     maybe_init_from_env()
@@ -65,11 +106,26 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--size", type=int, default=256, help="domain for the partition hint")
     p.add_argument("--radius", type=int, default=1)
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
+    p.add_argument("--json", action="store_true",
+                   help="emit the inventory as telemetry JSONL on stdout "
+                        "(and to --metrics-out when given) instead of text")
+    from ._bench_common import add_metrics_flags, start_metrics
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    rec = start_metrics(args, "machine_info")
     r = run(size=args.size, radius=args.radius)
+    if args.json:
+        stdout_rec = telemetry.Recorder(sink=sys.stdout, app="machine_info",
+                                        run_id=rec.run_id)
+        emit_records(r, stdout_rec)
+        if rec.enabled:
+            emit_records(r, rec)
+        return 0
+    if rec.enabled:
+        emit_records(r, rec)
     print(report(r))
     return 0
 

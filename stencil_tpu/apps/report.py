@@ -1,0 +1,268 @@
+"""report — aggregate telemetry metrics JSONL into trimean tables.
+
+Consumes the one-JSON-object-per-line files the bench apps write via
+``--metrics-out`` (schema: stencil_tpu/obs/telemetry.py), across any
+number of files/processes/runs, and reports:
+
+- spans: per-name count / min / trimean / max seconds
+  (``utils/statistics.Statistics`` — the reference's canonical trimean,
+  bin/statistics.hpp:17);
+- counters: the static byte/count truth (collective census, DMA bytes,
+  logical/moved exchange bytes) with cross-record consistency flagged;
+- gauges: per-name trimean (throughputs, timer buckets);
+- an optional vs-baseline delta against a JSON file of recorded numbers
+  (BASELINE.json / a bench.py payload / any flat {name: number} map).
+
+``--validate`` makes it the CI schema gate: every line must parse and
+satisfy the telemetry schema, or the exit code is 1.
+
+Usage:
+  python -m stencil_tpu.apps.report m1.jsonl [m2.jsonl ...] [--markdown]
+  python -m stencil_tpu.apps.report metrics.jsonl --validate
+  python -m stencil_tpu.apps.report metrics.jsonl --baseline BASELINE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import telemetry
+from ..utils.statistics import Statistics
+
+
+def load(paths: List[str]) -> Tuple[List[dict], List[str]]:
+    """Read + schema-validate records from JSONL files.
+
+    Returns (valid records, error strings); invalid lines are reported,
+    not silently dropped into the aggregate.
+    """
+    records: List[dict] = []
+    errors: List[str] = []
+    for path in paths:
+        with open(path) as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    errors.append(f"{path}:{i}: unparseable JSON ({e})")
+                    continue
+                errs = telemetry.validate_record(rec)
+                if errs:
+                    errors.extend(f"{path}:{i}: {e}" for e in errs)
+                else:
+                    records.append(rec)
+    return records, errors
+
+
+def _agg_key(rec: dict) -> str:
+    """Aggregation key: the record name, split per exchange method when a
+    ``method`` tag is present — a method-ablation run intentionally emits
+    different census/byte/timing values per method, and folding them under
+    one name would mix timings and false-positive the DISAGREE flag."""
+    if "method" in rec:
+        return f"{rec['name']}[{rec['method']}]"
+    return rec["name"]
+
+
+def aggregate(records: List[dict]) -> dict:
+    """Fold records into per-name statistics (per-method names when
+    tagged, see :func:`_agg_key`).
+
+    Spans and gauges aggregate across processes AND runs (each sample
+    keeps equal weight — the reference trimean discipline). Counters are
+    static truths PER CONFIGURATION — one key can legitimately carry
+    several distinct values (a radius sweep in one run, multiple runs
+    appended to one file), so the table shows the distinct set as a range
+    rather than presuming agreement.
+    """
+    spans: Dict[str, Statistics] = {}
+    span_phase: Dict[str, str] = {}
+    gauges: Dict[str, Statistics] = {}
+    counters: Dict[str, dict] = {}
+    runs, procs, apps = set(), set(), set()
+    for rec in records:
+        runs.add(rec["run"])
+        procs.add(rec["proc"])
+        if "app" in rec:
+            apps.add(rec["app"])
+        kind, name = rec["kind"], _agg_key(rec)
+        if kind == "span":
+            spans.setdefault(name, Statistics()).insert(rec["seconds"])
+            if "phase" in rec:
+                span_phase[name] = rec["phase"]
+        elif kind == "gauge":
+            gauges.setdefault(name, Statistics()).insert(rec["value"])
+        elif kind == "counter":
+            c = counters.setdefault(
+                name, {"n": 0, "value": set(), "bytes": set()}
+            )
+            c["n"] += 1
+            if "value" in rec:
+                c["value"].add(rec["value"])
+            if "bytes" in rec:
+                c["bytes"].add(rec["bytes"])
+    return {
+        "spans": spans,
+        "span_phase": span_phase,
+        "gauges": gauges,
+        "counters": counters,
+        "runs": sorted(runs),
+        "procs": sorted(procs),
+        "apps": sorted(apps),
+        "n_records": len(records),
+    }
+
+
+def _fmt_set(s: set) -> str:
+    if not s:
+        return "-"
+    if len(s) == 1:
+        return str(next(iter(s)))
+    return f"{min(s)}..{max(s)} ({len(s)} distinct)"
+
+
+def _rows_to_table(header: List[str], rows: List[List[str]],
+                   markdown: bool) -> List[str]:
+    if markdown:
+        out = ["| " + " | ".join(header) + " |",
+               "|" + "|".join("---" for _ in header) + "|"]
+        out += ["| " + " | ".join(r) + " |" for r in rows]
+        return out
+    out = [",".join(header)]
+    out += [",".join(r) for r in rows]
+    return out
+
+
+def tables(agg: dict, markdown: bool = False) -> str:
+    """The human/CI-facing report: spans, counters, gauges."""
+    lines: List[str] = []
+    head = (
+        f"{agg['n_records']} records · runs={len(agg['runs'])} "
+        f"procs={agg['procs']} apps={','.join(agg['apps']) or '-'}"
+    )
+    lines.append(("### metrics report\n" + head) if markdown else "# " + head)
+
+    if agg["spans"]:
+        rows = [
+            [name, agg["span_phase"].get(name, "-"), str(st.count()),
+             f"{st.min():.6f}", f"{st.trimean():.6f}", f"{st.max():.6f}"]
+            for name, st in sorted(agg["spans"].items())
+        ]
+        lines.append("" if markdown else "# spans")
+        if markdown:
+            lines.append("**spans**")
+        lines += _rows_to_table(
+            ["span", "phase", "n", "min_s", "trimean_s", "max_s"],
+            rows, markdown)
+
+    if agg["counters"]:
+        rows = [
+            [name, str(c["n"]), _fmt_set(c["value"]), _fmt_set(c["bytes"])]
+            for name, c in sorted(agg["counters"].items())
+        ]
+        lines.append("" if markdown else "# counters")
+        if markdown:
+            lines.append("**counters**")
+        lines += _rows_to_table(["counter", "n", "value", "bytes"],
+                                rows, markdown)
+
+    if agg["gauges"]:
+        rows = [
+            [name, str(st.count()), f"{st.trimean():.6g}"]
+            for name, st in sorted(agg["gauges"].items())
+        ]
+        lines.append("" if markdown else "# gauges")
+        if markdown:
+            lines.append("**gauges**")
+        lines += _rows_to_table(["gauge", "n", "trimean"], rows, markdown)
+    return "\n".join(lines)
+
+
+def _flatten_numeric(obj, prefix: str = "") -> Dict[str, float]:
+    """Dotted-path map of every numeric leaf in a baseline JSON — accepts
+    BASELINE.json, a bench.py payload ({"metric": ..., "value": ...}), or
+    any flat {name: number} map."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        if isinstance(obj.get("metric"), str) and isinstance(
+                obj.get("value"), (int, float)):
+            out[obj["metric"]] = float(obj["value"])
+        for k, v in obj.items():
+            out.update(_flatten_numeric(v, f"{prefix}{k}." if prefix or k else ""))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if prefix:
+            out[prefix[:-1]] = float(obj)
+    return out
+
+
+def baseline_delta(agg: dict, baseline: dict,
+                   markdown: bool = False) -> str:
+    """Gauge-vs-baseline ratios for every gauge whose name matches a
+    numeric baseline entry (exact name, or last dotted component)."""
+    flat = _flatten_numeric(baseline)
+    by_leaf: Dict[str, Tuple[str, float]] = {}
+    for k, v in flat.items():
+        by_leaf.setdefault(k.split(".")[-1], (k, v))
+    rows: List[List[str]] = []
+    for name, st in sorted(agg["gauges"].items()):
+        match: Optional[Tuple[str, float]] = None
+        if name in flat:
+            match = (name, flat[name])
+        elif name.split(".")[-1] in by_leaf:
+            match = by_leaf[name.split(".")[-1]]
+        if match is None or match[1] == 0:
+            continue
+        key, base = match
+        rows.append([name, f"{st.trimean():.6g}", f"{base:.6g}",
+                     f"{st.trimean() / base:.3f}", key])
+    if not rows:
+        return ("_no gauge matches a numeric baseline entry_" if markdown
+                else "# vs-baseline: no gauge matches a numeric baseline entry")
+    lines = ["**vs baseline**"] if markdown else ["# vs baseline"]
+    lines += _rows_to_table(
+        ["gauge", "trimean", "baseline", "ratio", "baseline_key"],
+        rows, markdown)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="aggregate telemetry metrics JSONL into trimean tables")
+    p.add_argument("paths", nargs="+", help="metrics JSONL file(s)")
+    p.add_argument("--markdown", action="store_true",
+                   help="markdown tables instead of CSV")
+    p.add_argument("--baseline", default="",
+                   help="JSON of recorded numbers for a vs-baseline delta")
+    p.add_argument("--validate", action="store_true",
+                   help="schema-gate mode: exit 1 on any invalid line")
+    p.add_argument("--out", default="", help="also write the report here")
+    args = p.parse_args(argv)
+
+    records, errors = load(args.paths)
+    if errors:
+        for e in errors:
+            print(f"SCHEMA: {e}")
+    if args.validate:
+        print(f"{len(records)} valid records, {len(errors)} schema errors")
+        return 1 if errors or not records else 0
+
+    agg = aggregate(records)
+    text = tables(agg, markdown=args.markdown)
+    if args.baseline:
+        with open(args.baseline) as f:
+            text += "\n" + baseline_delta(agg, json.load(f),
+                                          markdown=args.markdown)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

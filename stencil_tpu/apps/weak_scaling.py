@@ -49,6 +49,7 @@ from typing import Optional
 import jax
 
 from ..geometry import Dim3
+from ..obs import telemetry
 from ..parallel import Method
 from ..utils import logging as log
 from . import bench_exchange, exchange_weak, jacobi3d, measure_overlap
@@ -152,6 +153,11 @@ def run(
     rows.append(("config5_hidden_frac", ov["x"], ov["y"], ov["z"], n,
                  ov["overlap_s"], ov["hidden_s"], ov["hidden_frac"]))
 
+    rec = telemetry.get()
+    if rec.enabled:
+        for name, _x, _y, _z, _n, secs, thr, eff in rows:
+            rec.gauge(f"weak.{name}.seconds", secs, phase="scaling", unit="s")
+            rec.gauge(f"weak.{name}.efficiency", eff, phase="scaling")
     return {
         "devices": n,
         "rows": rows,
@@ -219,10 +225,14 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--out", default="", help="also append CSV to this file")
     p.add_argument("--pallas", dest="use_pallas", action="store_true",
                    default=None, help="force the Pallas overlap variant")
+    from ._bench_common import add_metrics_flags, start_metrics
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    # the config 2/3/5 sub-apps all record through this process recorder
+    start_metrics(args, "weak_scaling")
 
     if args.record_base:
         record_base(iters=args.iters or 360, path=args.base)

@@ -1,0 +1,20 @@
+"""obs — the flight recorder: unified telemetry + the stall watchdog.
+
+Two halves, deliberately decoupled:
+
+- :mod:`stencil_tpu.obs.telemetry` — a structured recorder of spans,
+  counters, and gauges flushed as one-JSON-object-per-line to a metrics
+  sink (the ``--metrics-out`` flag every bench app grows), riding the
+  existing :mod:`stencil_tpu.utils.timer` buckets + profiler annotations.
+- :mod:`stencil_tpu.obs.watchdog` — the revival watcher for stall-prone
+  tunneled-TPU measurement runs: supervises a child process on heartbeat
+  + total-budget deadlines, distinguishes stall from crash, retries with
+  backoff, archives logs. Pure stdlib, importable WITHOUT importing jax
+  (``bench.py``'s parent loads it by file path — the parent must never
+  touch a JAX backend).
+
+This package intentionally imports nothing at package level so that
+``stencil_tpu.obs.watchdog`` stays stdlib-weight when loaded directly.
+"""
+
+__all__ = ["telemetry", "watchdog"]

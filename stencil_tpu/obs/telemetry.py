@@ -1,0 +1,409 @@
+"""Structured telemetry: spans, counters, gauges → one-JSON-per-line sink.
+
+The reference keeps its performance story honest with global timer buckets
+(timer.hpp:44-47), NVTX ranges throughout src/stencil.cu, and Allreduced
+per-method byte counters (src/stencil.cu:139-161,620-627). This module
+unifies the TPU port's analogues of all three — ``utils/timer.py`` buckets
++ ``jax.profiler`` annotations, ``utils/hlo_check.collective_census``, and
+``utils/mosaic_traffic`` — behind one recorder whose records land as one
+JSON object per line in a metrics sink (``--metrics-out`` on every bench
+app), machine-readable by ``apps/report.py`` and CI.
+
+Record schema (v1) — every line carries:
+
+- ``v``:     schema version (1)
+- ``run``:   run id (shared by every record of one measurement run)
+- ``proc``:  JAX process index (0 when no backend is up — resolved lazily,
+             same discipline as utils/logging: recording a line must never
+             initialize a backend)
+- ``kind``:  ``span`` | ``counter`` | ``gauge`` | ``meta`` | ``heartbeat``
+- ``name``:  record name (e.g. ``jacobi.iter``, ``census.collective-permute``)
+- ``t``:     unix wall time of emission
+
+plus per kind: spans carry ``seconds`` (and usually ``phase``); counters
+carry ``value`` (a count) and/or ``bytes`` (a byte total — "bytes where
+applicable"); gauges carry ``value``; heartbeats carry ``seq``; metas are
+free-form. Anything else (``app``, ``phase``, ``method``, ``iters``, ...)
+is an optional tag. :func:`validate_record` is the one schema authority —
+CI validates every emitted line through it (``apps/report.py --validate``).
+
+Spans ride :func:`stencil_tpu.utils.timer.timed` (global buckets keep
+accumulating exactly as before) and ``timer.trace_range`` (so
+``jax.profiler`` gets the same named range for free).
+
+Heartbeats close the loop with :mod:`stencil_tpu.obs.watchdog`: when the
+supervisor set ``STENCIL_HEARTBEAT_FILE``, every emitted record (and a
+background thread, for long silent stretches like a 3-minute kernel
+compile) touches that file; the watchdog reads only its mtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..utils import timer
+from .watchdog import HEARTBEAT_FILE_ENV, HEARTBEAT_INTERVAL_ENV
+
+SCHEMA_VERSION = 1
+KINDS = ("span", "counter", "gauge", "meta", "heartbeat")
+REQUIRED_KEYS = ("v", "run", "proc", "kind", "name", "t")
+
+
+def new_run_id() -> str:
+    return time.strftime("%Y%m%dT%H%M%S") + "-" + uuid.uuid4().hex[:8]
+
+
+class Recorder:
+    """One measurement run's telemetry channel.
+
+    ``sink`` is a path (opened append) or a file-like object, or None — a
+    disabled recorder still accumulates timer buckets in spans and still
+    beats the watchdog heartbeat file, so supervision works even when no
+    metrics file was requested.
+    """
+
+    def __init__(
+        self,
+        sink=None,
+        run_id: Optional[str] = None,
+        app: Optional[str] = None,
+        clock=time.time,
+    ):
+        self.run_id = run_id or new_run_id()
+        self.app = app
+        self._clock = clock
+        self._owns_sink = isinstance(sink, (str, os.PathLike))
+        self._sink = open(sink, "a", buffering=1) if self._owns_sink else sink
+        self._lock = threading.Lock()
+        self._proc: Optional[int] = None
+        self._hb_path = os.environ.get(HEARTBEAT_FILE_ENV) or None
+        self._hb_interval = float(
+            os.environ.get(HEARTBEAT_INTERVAL_ENV, "5") or 5
+        )
+        self._hb_last = 0.0
+        self._hb_seq = 0
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+
+    @property
+    def enabled(self) -> bool:
+        """True when records are actually written somewhere."""
+        return self._sink is not None
+
+    # -- emission ------------------------------------------------------------
+    def emit(self, kind: str, name: str, *, phase: Optional[str] = None,
+             **fields) -> dict:
+        """Build one record, write it to the sink, touch the heartbeat.
+
+        Returns the record dict either way, so callers (machine_info
+        ``--json``) can route records themselves.
+        """
+        if self._proc is None:
+            # cache only once a backend answered; 0 from a backend-less
+            # process stays re-resolvable (utils/logging._prefix discipline)
+            proc = 0
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                try:
+                    proc = jax.process_index()
+                    self._proc = proc
+                except Exception:
+                    pass
+        else:
+            proc = self._proc
+        rec = {
+            "v": SCHEMA_VERSION,
+            "run": self.run_id,
+            "proc": proc,
+            "kind": kind,
+            "name": name,
+            "t": self._clock(),
+        }
+        if self.app:
+            rec["app"] = self.app
+        if phase is not None:
+            rec["phase"] = phase
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        if self._sink is not None:
+            line = json.dumps(rec, default=str)
+            with self._lock:
+                self._sink.write(line + "\n")
+                try:
+                    self._sink.flush()
+                except (OSError, ValueError):
+                    pass
+        self._maybe_beat()
+        return rec
+
+    @contextlib.contextmanager
+    def span(self, name: str, phase: Optional[str] = None,
+             bucket: Optional[str] = None, **tags):
+        """Timed region: timer bucket + profiler range + one span record.
+
+        The record is emitted even when the body raises (the failed span
+        is evidence), and the exception propagates — same discipline as
+        ``timer.trace_range``.
+        """
+        t0 = time.perf_counter()
+        try:
+            with timer.timed(bucket or name), timer.trace_range(name):
+                yield
+        finally:
+            self.emit("span", name, phase=phase,
+                      seconds=time.perf_counter() - t0, **tags)
+
+    def counter(self, name: str, value: Optional[int] = None,
+                bytes: Optional[int] = None, phase: Optional[str] = None,
+                **tags) -> dict:
+        return self.emit("counter", name, phase=phase, value=value,
+                         bytes=bytes, **tags)
+
+    def gauge(self, name: str, value: float, phase: Optional[str] = None,
+              unit: Optional[str] = None, **tags) -> dict:
+        return self.emit("gauge", name, phase=phase, value=value, unit=unit,
+                         **tags)
+
+    def meta(self, name: str, **fields) -> dict:
+        return self.emit("meta", name, **fields)
+
+    # -- heartbeat (watchdog contract) ---------------------------------------
+    def heartbeat(self) -> None:
+        """Touch the watchdog heartbeat file + emit a heartbeat record."""
+        self._hb_seq += 1
+        self._touch_hb()
+        if self._sink is not None:
+            self.emit("heartbeat", "hb", seq=self._hb_seq)
+        else:
+            self._hb_last = time.monotonic()
+
+    def _touch_hb(self) -> None:
+        if not self._hb_path:
+            return
+        try:
+            with open(self._hb_path, "w") as f:
+                f.write(f"{time.time()}\n")
+        except OSError:
+            pass  # a torn-down supervisor must not crash the measurement
+
+    def _maybe_beat(self) -> None:
+        """Rate-limited beat on every emission: a chatty child never needs
+        an explicit heartbeat call."""
+        if not self._hb_path:
+            return
+        now = time.monotonic()
+        if now - self._hb_last >= self._hb_interval:
+            self._hb_last = now
+            self._touch_hb()
+
+    def start_heartbeat_thread(self, interval_s: Optional[float] = None) -> bool:
+        """Beat from a daemon thread so long silent stretches (multi-minute
+        XLA compiles) do not read as stalls. A hard wedge that freezes the
+        interpreter freezes this thread too — which is exactly when the
+        watchdog SHOULD fire. No-op (returns False) without a supervisor.
+        """
+        if not self._hb_path or self._hb_thread is not None:
+            return False
+        interval = interval_s or self._hb_interval
+
+        def beat():
+            while not self._hb_stop.wait(interval):
+                self._hb_seq += 1
+                self._touch_hb()
+
+        self._touch_hb()  # first beat immediately: starts the stall clock
+        self._hb_thread = threading.Thread(
+            target=beat, name="stencil-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+        return True
+
+    # -- convenience ---------------------------------------------------------
+    def record_timer_buckets(self, phase: Optional[str] = None) -> None:
+        """Snapshot utils/timer's global buckets as gauges (the machine
+        analogue of the apps' exit-time ``timers:`` line)."""
+        for k, v in sorted(timer.buckets.items()):
+            self.gauge(f"timer.{k}", v, phase=phase, unit="s")
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        if self._owns_sink and self._sink is not None:
+            try:
+                self._sink.close()
+            finally:
+                self._sink = None
+
+
+# -- module-level default recorder -------------------------------------------
+
+_recorder: Optional[Recorder] = None
+
+
+def configure(metrics_out: Optional[str] = None, app: Optional[str] = None,
+              run_id: Optional[str] = None, config: Optional[dict] = None,
+              heartbeat_thread: bool = True) -> Recorder:
+    """Install the process-default recorder (what ``--metrics-out`` wires).
+
+    Emits the run's identity/config meta record first so every metrics
+    file is self-describing, and starts the watchdog heartbeat thread when
+    a supervisor is attached.
+    """
+    global _recorder
+    if _recorder is not None:
+        _recorder.close()
+    _recorder = Recorder(sink=metrics_out or None, app=app, run_id=run_id)
+    if config:
+        clean = {k: v for k, v in config.items()
+                 if isinstance(v, (str, int, float, bool, type(None)))}
+        _recorder.meta("config", config=clean)
+    if heartbeat_thread:
+        _recorder.start_heartbeat_thread()
+    return _recorder
+
+
+def get() -> Recorder:
+    """The process-default recorder (a disabled one before configure())."""
+    global _recorder
+    if _recorder is None:
+        _recorder = Recorder(sink=None)
+    return _recorder
+
+
+def enabled() -> bool:
+    return _recorder is not None and _recorder.enabled
+
+
+# -- static truth: what the compiled artifacts say moves ---------------------
+
+
+def record_census(census: Dict[str, Tuple[int, int]],
+                  rec: Optional[Recorder] = None, **tags) -> None:
+    """Record a ``collective_census`` result ({kind: (count, bytes)}) —
+    one counter line per collective kind."""
+    rec = rec or get()
+    for kind, (count, nbytes) in sorted(census.items()):
+        rec.counter(f"census.{kind}", value=count, bytes=nbytes,
+                    phase="exchange", **tags)
+
+
+def record_exchange_truth(ex, state, itemsizes: Sequence[int],
+                          rec: Optional[Recorder] = None, **tags) -> dict:
+    """Attach one exchange method's compile-time truth to the run: the
+    collective census of the compiled program (exact on-wire volume — the
+    analogue of the reference's Allreduced per-method byte counters,
+    src/stencil.cu:139-161) plus the logical/moved byte accounting.
+
+    Compiles one single-exchange program; callers gate on
+    :func:`enabled` so metric-less runs pay nothing.
+    """
+    rec = rec or get()
+    census = ex.collective_census(state)
+    method = getattr(ex.method, "value", str(ex.method))
+    record_census(census, rec, method=method, **tags)
+    rec.counter("exchange.bytes_logical", bytes=ex.bytes_logical(itemsizes),
+                phase="exchange", method=method, **tags)
+    rec.counter("exchange.bytes_moved", bytes=ex.bytes_moved(itemsizes),
+                phase="exchange", method=method, **tags)
+    return census
+
+
+def record_dma_traffic(build, rec: Optional[Recorder] = None,
+                       **tags) -> list:
+    """Attach the Mosaic kernels' static DMA truth: lower ``build()``'s
+    Pallas kernels for the TPU platform (utils/mosaic_traffic) and record
+    per-kernel HBM input/output bytes per grid pass.
+
+    Expensive (a full TPU lowering) and not reentrant — callers gate it
+    behind an explicit flag. A capture failure records a meta line instead
+    of raising: the DMA truth is evidence, never the measurement.
+    """
+    rec = rec or get()
+    from ..utils.mosaic_traffic import capture_traffic
+
+    try:
+        kernels = capture_traffic(build)
+    except Exception as e:
+        rec.meta("dma.capture_error", error=f"{type(e).__name__}: {e}"[:400],
+                 **tags)
+        return []
+    for kt in kernels:
+        rec.counter(f"dma.{kt.name}.in", bytes=kt.input_bytes(),
+                    value=kt.steps, phase="compute", grid=list(kt.grid),
+                    **tags)
+        rec.counter(f"dma.{kt.name}.out", bytes=kt.output_bytes(),
+                    value=kt.steps, phase="compute", grid=list(kt.grid),
+                    **tags)
+    return kernels
+
+
+# -- schema validation (the authority apps/report.py + CI use) ---------------
+
+
+def validate_record(rec) -> List[str]:
+    """Return the list of schema violations (empty = valid v1 record)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"not an object: {type(rec).__name__}"]
+    for k in REQUIRED_KEYS:
+        if k not in rec:
+            errs.append(f"missing required key {k!r}")
+    if errs:
+        return errs
+    if rec["v"] != SCHEMA_VERSION:
+        errs.append(f"unknown schema version {rec['v']!r}")
+    if not isinstance(rec["run"], str) or not rec["run"]:
+        errs.append("run must be a non-empty string")
+    if not isinstance(rec["proc"], int):
+        errs.append("proc must be an int")
+    if not isinstance(rec["name"], str) or not rec["name"]:
+        errs.append("name must be a non-empty string")
+    if not isinstance(rec["t"], (int, float)):
+        errs.append("t must be a number")
+    kind = rec["kind"]
+    if kind not in KINDS:
+        errs.append(f"unknown kind {kind!r}")
+    elif kind == "span":
+        if not isinstance(rec.get("seconds"), (int, float)):
+            errs.append("span requires numeric 'seconds'")
+    elif kind == "counter":
+        if not isinstance(rec.get("value"), int) and not isinstance(
+                rec.get("bytes"), int):
+            errs.append("counter requires integer 'value' and/or 'bytes'")
+    elif kind == "gauge":
+        if not isinstance(rec.get("value"), (int, float)):
+            errs.append("gauge requires numeric 'value'")
+    elif kind == "heartbeat":
+        if not isinstance(rec.get("seq"), int):
+            errs.append("heartbeat requires integer 'seq'")
+    if "bytes" in rec and not isinstance(rec["bytes"], int):
+        errs.append("'bytes' must be an integer where present")
+    return errs
+
+
+def validate_jsonl(lines: Iterable[str]) -> Tuple[int, List[str]]:
+    """Validate an iterable of JSONL lines; returns (n_valid, errors)."""
+    n_ok = 0
+    errors: List[str] = []
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {i}: unparseable JSON ({e})")
+            continue
+        errs = validate_record(rec)
+        if errs:
+            errors.extend(f"line {i}: {e}" for e in errs)
+        else:
+            n_ok += 1
+    return n_ok, errors

@@ -31,6 +31,7 @@ from jax import lax
 
 from ..geometry import Dim3, Radius, Rect3, exterior_regions, interior_region
 from ..parallel.exchange import BLOCK_PSPEC, HaloExchange, Method
+from ..utils import timer
 
 HOT_TEMP = 1.0
 COLD_TEMP = 0.0
@@ -184,8 +185,12 @@ def make_jacobi_step(ex: HaloExchange, overlap: bool = True, use_pallas=None,
     block index, so the overlap structure survives uneven splits exactly as
     the reference's per-LocalDomain regions do (src/stencil.cu:878-977).
     """
-    return _compile_jacobi(ex, overlap, iters=None, use_pallas=use_pallas,
-                           standard_spheres=standard_spheres, interpret=interpret)
+    # host-side build phase (kernel selection + closure construction); the
+    # first invocation's XLA compile lands in the caller's warmup span
+    with timer.timed("jacobi.build"), timer.trace_range("jacobi.build"):
+        return _compile_jacobi(ex, overlap, iters=None, use_pallas=use_pallas,
+                               standard_spheres=standard_spheres,
+                               interpret=interpret)
 
 
 def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pallas=None,
@@ -218,9 +223,13 @@ def make_jacobi_loop(ex: HaloExchange, iters: int, overlap: bool = True, use_pal
     full planes while they reach the depth, row strips beyond) — the
     probing knob behind ``jacobi3d --multistep-rows``.
     """
-    return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas,
-                           standard_spheres=standard_spheres, interpret=interpret,
-                           temporal_k=temporal_k, multistep_rows=multistep_rows)
+    # same build-phase accounting as make_jacobi_step: the multistep plan
+    # (staging/row-tiling decisions) is constructed here, on the host
+    with timer.timed("jacobi.build"), timer.trace_range("jacobi.build"):
+        return _compile_jacobi(ex, overlap, iters=iters, use_pallas=use_pallas,
+                               standard_spheres=standard_spheres,
+                               interpret=interpret, temporal_k=temporal_k,
+                               multistep_rows=multistep_rows)
 
 
 def _want_pallas(ex: HaloExchange, use_pallas) -> bool:

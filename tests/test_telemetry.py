@@ -1,0 +1,200 @@
+"""Telemetry recorder + metrics schema + report aggregation tests.
+
+The schema assertions here are the same authority CI's JSONL gate uses
+(telemetry.validate_record via apps/report.py --validate): run id,
+process index, span name + seconds, bytes where applicable.
+"""
+
+import io
+import json
+
+import jax
+import pytest
+
+from stencil_tpu.apps import report
+from stencil_tpu.obs import telemetry
+from stencil_tpu.utils import timer
+
+
+def _records(buf: io.StringIO):
+    return [json.loads(l) for l in buf.getvalue().splitlines() if l.strip()]
+
+
+def test_recorder_emits_schema_valid_records():
+    buf = io.StringIO()
+    rec = telemetry.Recorder(sink=buf, app="t", run_id="RUN")
+    with rec.span("work", phase="step", iters=3):
+        pass
+    rec.counter("census.collective-permute", value=6, bytes=123,
+                phase="exchange")
+    rec.counter("only.bytes", bytes=7)
+    rec.gauge("speed", 1.5, unit="GB/s")
+    rec.meta("config", config={"x": 1})
+    rec.heartbeat()
+    recs = _records(buf)
+    assert [r["kind"] for r in recs] == [
+        "span", "counter", "counter", "gauge", "meta", "heartbeat",
+    ]
+    for r in recs:
+        assert telemetry.validate_record(r) == [], r
+        assert r["run"] == "RUN" and r["proc"] == 0 and r["app"] == "t"
+    span = recs[0]
+    assert span["seconds"] >= 0 and span["phase"] == "step"
+    assert span["iters"] == 3
+    assert recs[1]["value"] == 6 and recs[1]["bytes"] == 123
+
+
+def test_span_rides_timer_buckets_and_survives_exceptions():
+    timer.reset()
+    buf = io.StringIO()
+    rec = telemetry.Recorder(sink=buf)
+    with pytest.raises(ValueError, match="boom"):
+        with rec.span("failing"):
+            raise ValueError("boom")
+    recs = _records(buf)
+    assert recs[-1]["kind"] == "span" and recs[-1]["name"] == "failing"
+    # the shared bucket accumulated too (timed + trace_range underneath)
+    assert "failing" in timer.buckets
+
+
+def test_disabled_recorder_still_times():
+    timer.reset()
+    rec = telemetry.Recorder(sink=None)
+    assert not rec.enabled
+    with rec.span("quiet"):
+        pass
+    assert "quiet" in timer.buckets
+
+
+def test_validate_record_catches_violations():
+    ok = {"v": 1, "run": "r", "proc": 0, "kind": "span", "name": "s",
+          "t": 0.0, "seconds": 0.1}
+    assert telemetry.validate_record(ok) == []
+    assert telemetry.validate_record({})  # missing everything
+    assert telemetry.validate_record("not a dict")
+    bad = dict(ok)
+    del bad["seconds"]
+    assert telemetry.validate_record(bad)  # span without seconds
+    assert telemetry.validate_record(dict(ok, kind="bogus"))
+    ctr = {"v": 1, "run": "r", "proc": 0, "kind": "counter", "name": "c",
+           "t": 0.0}
+    assert telemetry.validate_record(ctr)  # counter with no value/bytes
+    assert telemetry.validate_record(dict(ctr, bytes=5)) == []
+    assert telemetry.validate_record(dict(ctr, value=5)) == []
+    assert telemetry.validate_record(dict(ctr, bytes=1.5))  # non-int bytes
+    gauge = {"v": 1, "run": "r", "proc": 0, "kind": "gauge", "name": "g",
+             "t": 0.0}
+    assert telemetry.validate_record(gauge)
+    assert telemetry.validate_record(dict(gauge, value=2.5)) == []
+
+
+def test_exchange_truth_lands_in_metrics_file(tmp_path):
+    """Integration: time_exchange with the recorder enabled emits phase
+    spans AND the census/byte counters, all schema-valid."""
+    from stencil_tpu.apps._bench_common import time_exchange
+    from stencil_tpu.geometry import Dim3, Radius
+
+    path = str(tmp_path / "m.jsonl")
+    telemetry.configure(metrics_out=path, app="test")
+    try:
+        time_exchange(Dim3(16, 16, 16), Radius.constant(1), iters=2,
+                      devices=jax.devices()[:8], quantities=2, chunk=2)
+    finally:
+        telemetry.configure(metrics_out=None)  # back to disabled
+    records, errors = report.load([path])
+    assert errors == []
+    names = {r["name"] for r in records}
+    assert {"exchange.warmup", "exchange.iter",
+            "census.collective-permute", "exchange.bytes_logical",
+            "exchange.bytes_moved", "exchange.trimean_s",
+            "exchange.gb_per_s"} <= names
+    cp = next(r for r in records if r["name"] == "census.collective-permute")
+    # composed method: 6 hand-written permutes per quantity, 2 quantities
+    assert cp["value"] == 6 * 2
+    assert cp["bytes"] > 0
+    bl = next(r for r in records if r["name"] == "exchange.bytes_logical")
+    assert bl["bytes"] > 0
+
+
+def test_record_dma_traffic_failure_is_evidence_not_crash():
+    # a capture failure must record a meta line, never raise: the DMA
+    # truth is evidence attached to the run, not the measurement itself
+    buf = io.StringIO()
+    rec = telemetry.Recorder(sink=buf)
+
+    def exploding_build():
+        raise RuntimeError("no kernels here")
+
+    assert telemetry.record_dma_traffic(exploding_build, rec) == []
+    recs = _records(buf)
+    assert recs[-1]["name"] == "dma.capture_error"
+    assert "no kernels here" in recs[-1]["error"]
+
+
+def test_report_aggregation_tables_and_baseline(tmp_path):
+    path = tmp_path / "m.jsonl"
+    base = {"v": 1, "run": "r1", "proc": 0, "t": 0.0}
+    rows = [
+        dict(base, kind="span", name="s", phase="step", seconds=1.0),
+        dict(base, kind="span", name="s", phase="step", seconds=2.0),
+        dict(base, kind="span", name="s", phase="step", seconds=3.0, run="r2",
+             proc=1),
+        dict(base, kind="counter", name="c", bytes=10),
+        dict(base, kind="counter", name="c", bytes=11),  # disagreement
+        dict(base, kind="gauge", name="speed", value=2.0),
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    records, errors = report.load([str(path)])
+    assert errors == []
+    agg = report.aggregate(records)
+    assert agg["spans"]["s"].count() == 3
+    assert agg["spans"]["s"].trimean() == 2.0
+    assert agg["runs"] == ["r1", "r2"] and agg["procs"] == [0, 1]
+    text = report.tables(agg)
+    assert "s,step,3," in text and "10..11 (2 distinct)" in text
+    md = report.tables(agg, markdown=True)
+    assert "| s | step | 3 |" in md
+    # baseline delta: nested numeric leaves AND bench-payload form match
+    delta = report.baseline_delta(agg, {"published": {"speed": 1.0}})
+    assert "2.000" in delta
+    delta2 = report.baseline_delta(agg, {"metric": "speed", "value": 4.0})
+    assert "0.500" in delta2
+    assert "no gauge matches" in report.baseline_delta(agg, {"other": 1.0})
+
+
+def test_report_validate_cli(tmp_path, capsys):
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(
+        {"v": 1, "run": "r", "proc": 0, "kind": "gauge", "name": "g",
+         "t": 0.0, "value": 1.0}) + "\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json at all\n" + json.dumps(
+        {"v": 1, "run": "r", "kind": "span", "name": "s"}) + "\n")
+    assert report.main([str(good), "--validate"]) == 0
+    assert report.main([str(bad), "--validate"]) == 1
+    assert report.main([str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "g" in out
+
+
+def test_machine_info_json_records():
+    from stencil_tpu.apps import machine_info
+
+    r = machine_info.run(devices=jax.devices()[:8], size=64)
+    buf = io.StringIO()
+    rec = telemetry.Recorder(sink=buf, app="machine_info")
+    out = machine_info.emit_records(r, rec)
+    recs = _records(buf)
+    # machine + 8 devices + partition + 2 matrices
+    assert len(recs) == len(out) == 1 + 8 + 1 + 2
+    for rr in recs:
+        assert telemetry.validate_record(rr) == [], rr
+    devs = [rr for rr in recs if rr["name"] == "machine.device"]
+    assert len(devs) == 8
+    assert all(rr["platform"] == "cpu" for rr in devs)
+    m = next(rr for rr in recs if rr["name"] == "machine")
+    assert m["devices"] == 8
+    dm = next(rr for rr in recs if rr["name"] == "machine.distance_matrix")
+    assert len(dm["matrix"]) == 8 and len(dm["matrix"][0]) == 8
+    part = next(rr for rr in recs if rr["name"] == "machine.partition")
+    assert len(part["dim"]) == 3

@@ -1,0 +1,69 @@
+"""Unit tests for utils/timer.py: bucket accumulation, reset, and the
+exception discipline of trace_range (a body exception must propagate —
+the docstring's "generator didn't stop after throw()" hazard)."""
+
+import time
+
+import pytest
+
+from stencil_tpu.utils import timer
+
+
+def test_bucket_accumulation_and_report():
+    timer.reset()
+    with timer.timed("a"):
+        time.sleep(0.01)
+    first = timer.buckets["a"]
+    assert first >= 0.01
+    with timer.timed("a"):
+        time.sleep(0.01)
+    # accumulates into the same bucket (reference: timer.hpp:44-47), never
+    # overwrites
+    assert timer.buckets["a"] > first
+    with timer.timed("b"):
+        pass
+    assert set(timer.buckets) >= {"a", "b"}
+    rep = timer.report()
+    assert rep.startswith("timers: ") and "a=" in rep and "b=" in rep
+
+
+def test_reset_clears_buckets():
+    with timer.timed("x"):
+        pass
+    timer.reset()
+    assert not timer.buckets
+    assert timer.report() == "timers: (empty)"
+
+
+def test_timed_records_even_when_body_raises():
+    timer.reset()
+    with pytest.raises(ValueError, match="boom"):
+        with timer.timed("failing"):
+            raise ValueError("boom")
+    # the finally-accumulate: a crashed region still leaves its time
+    assert "failing" in timer.buckets
+
+
+def test_trace_range_propagates_body_exception():
+    with pytest.raises(ValueError, match="boom"):
+        with timer.trace_range("r"):
+            raise ValueError("boom")
+
+
+def test_trace_range_body_runs():
+    ran = []
+    with timer.trace_range("r2"):
+        ran.append(1)
+    assert ran == [1]
+
+
+def test_time_fn_decorator():
+    timer.reset()
+
+    @timer.time_fn("deco")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert f.__name__ == "f"
+    assert "deco" in timer.buckets

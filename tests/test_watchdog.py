@@ -1,0 +1,158 @@
+"""Unit tests for obs/watchdog.py — the revival watcher.
+
+The injected-stall test is the CI requirement from ISSUE 3: a child that
+beats, then sleeps past the heartbeat deadline, must be detected as a
+STALL (not a timeout), killed, retried, and the ladder reported — with
+the child log archived at every rung.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+from stencil_tpu.obs import watchdog
+
+PY = sys.executable
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Beats the heartbeat file three times, then wedges far past any deadline.
+STALL_CHILD = textwrap.dedent(
+    """
+    import os, time
+    hb = os.environ["STENCIL_HEARTBEAT_FILE"]
+    for _ in range(3):
+        with open(hb, "w") as f:
+            f.write(str(time.time()))
+        time.sleep(0.2)
+    print("beaten; wedging now", flush=True)
+    time.sleep(300)
+    """
+)
+
+
+def test_supervise_ok_captures_stdout():
+    att = watchdog.supervise([PY, "-c", "print('RESULT 42')"],
+                             timeout_s=60, name="ok")
+    assert att.outcome == watchdog.OK
+    assert att.rc == 0
+    assert "RESULT 42" in att.stdout
+
+
+def test_supervise_distinguishes_crash():
+    att = watchdog.supervise([PY, "-c", "import sys; sys.exit(3)"],
+                             timeout_s=60, name="crash")
+    assert att.outcome == watchdog.CRASH
+    assert att.rc == 3
+
+
+def test_supervise_timeout_kills_and_archives(tmp_path):
+    att = watchdog.supervise(
+        [PY, "-c", "import time; print('partial', flush=True); time.sleep(300)"],
+        timeout_s=2.0, poll_s=0.1, name="sleeper",
+        archive_dir=str(tmp_path),
+    )
+    assert att.outcome == watchdog.TIMEOUT
+    assert att.rc is None
+    # pre-kill output survives (file-backed, not pipe-backed)
+    assert "partial" in att.stdout
+    assert att.log_path and os.path.exists(att.log_path)
+    assert "partial" in open(att.log_path).read()
+
+
+def test_supervise_detects_stall_before_budget():
+    """The injected stall: beats, then silence past the heartbeat deadline
+    — killed as STALL long before the 120 s total budget."""
+    att = watchdog.supervise(
+        [PY, "-c", STALL_CHILD],
+        timeout_s=120, heartbeat_timeout_s=1.5, first_beat_grace_s=60,
+        poll_s=0.1, name="staller",
+    )
+    assert att.outcome == watchdog.STALL
+    assert att.rc is None
+    assert att.seconds < 60  # the heartbeat deadline fired, not the budget
+    assert "beaten; wedging now" in att.stdout
+
+
+def test_supervise_never_beaten_uses_first_beat_grace():
+    att = watchdog.supervise(
+        [PY, "-c", "import time; time.sleep(300)"],
+        timeout_s=120, heartbeat_timeout_s=60, first_beat_grace_s=1.5,
+        poll_s=0.1, name="mute",
+    )
+    assert att.outcome == watchdog.STALL
+    assert att.seconds < 60
+
+
+def test_telemetry_heartbeats_feed_the_watchdog():
+    """The integration the bench children rely on: heartbeats emitted by
+    stencil_tpu.obs.telemetry (configure() starts the beat thread) keep a
+    healthy child alive under a tight between-beats deadline."""
+    child = textwrap.dedent(
+        """
+        import time
+        from stencil_tpu.obs import telemetry
+        rec = telemetry.configure(app="hb-child")
+        for _ in range(4):
+            rec.heartbeat()
+            time.sleep(0.3)
+        print("HB_OK", flush=True)
+        """
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env[watchdog.HEARTBEAT_INTERVAL_ENV] = "0.5"
+    att = watchdog.supervise(
+        [PY, "-c", child],
+        timeout_s=180, heartbeat_timeout_s=5.0, first_beat_grace_s=150,
+        poll_s=0.1, name="telemetry-child", env=env, cwd=REPO,
+    )
+    assert att.outcome == watchdog.OK, (att.outcome, att.stderr_tail)
+    assert "HB_OK" in att.stdout
+
+
+def _parse_result(stdout):
+    for line in stdout.splitlines():
+        if line.startswith("RESULT "):
+            try:
+                return json.loads(line[len("RESULT "):])
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def test_revival_detect_kill_retry_report(tmp_path):
+    """The full ladder: stall detected -> killed -> retried with a healthy
+    child -> payload delivered -> both attempts reported + archived."""
+    rev = watchdog.Revival(budget_s=120, parse=_parse_result,
+                           archive_dir=str(tmp_path), min_attempt_s=1.0)
+    p1 = rev.attempt("stall-rung", [PY, "-c", STALL_CHILD], timeout_s=60,
+                     heartbeat_timeout_s=1.5, first_beat_grace_s=60)
+    assert p1 is None
+    p2 = rev.attempt(
+        "good-rung", [PY, "-c", "print('RESULT {\"value\": 7}')"],
+        timeout_s=30,
+    )
+    assert p2 == {"value": 7}
+    assert [a.outcome for a in rev.attempts] == [watchdog.STALL, watchdog.OK]
+    assert all(a.log_path and os.path.exists(a.log_path)
+               for a in rev.attempts)
+    rep = rev.report()
+    assert rep[0]["outcome"] == "stall" and rep[1]["outcome"] == "ok"
+
+
+def test_revival_no_result_and_budget_refusal():
+    rev = watchdog.Revival(budget_s=60, parse=_parse_result,
+                           min_attempt_s=1.0)
+    assert rev.attempt("empty", [PY, "-c", "print('nothing')"],
+                       timeout_s=30) is None
+    assert rev.attempts[0].outcome == watchdog.NO_RESULT
+    spent = watchdog.Revival(budget_s=0.0, parse=_parse_result)
+    assert spent.attempt("refused", [PY, "-c", "print(1)"],
+                         timeout_s=30) is None
+    assert spent.attempts == []  # refused before spawning
+    # the floor overrides an exhausted budget (the last-resort rung)
+    assert spent.attempt(
+        "floored", [PY, "-c", "print('RESULT {\"v\": 1}')"],
+        timeout_s=30, floor_timeout_s=30.0,
+    ) == {"v": 1}
