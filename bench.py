@@ -54,8 +54,15 @@ SENTINEL = "STENCIL_BENCH_JSON: "
 # ---------------------------------------------------------------- child side
 
 
-def _child_main(mode: str) -> int:
-    """Measure and print SENTINEL+JSON. ``mode``: 'accel' | 'cpu'."""
+def _child_main(mode: str, resume: bool = False) -> int:
+    """Measure and print SENTINEL+JSON. ``mode``: 'accel' | 'cpu'.
+
+    ``resume`` is what the parent's Revival ladder passes on every rung
+    after the first: with STENCIL_BENCH_CKPT_DIR set, the jacobi headline
+    leg checkpoints per chunk and a revived child continues from its last
+    durable step instead of step 0 (a CPU fallback whose domain differs
+    simply finds no compatible snapshot and starts fresh — the elastic
+    restore degrades, never crashes)."""
     hang = float(os.environ.get("STENCIL_BENCH_SELFTEST_HANG_S", "0") or 0)
     if hang and mode == "accel":
         # self-test hook (tests/test_driver_hardening.py): simulate the
@@ -107,10 +114,30 @@ def _child_main(mode: str) -> int:
     from stencil_tpu.utils.sync import hard_sync
 
     # headline jacobi: REQUIRED — if this dies the child fails and the
-    # parent falls back
+    # parent falls back. With a checkpoint dir, the leg is durable per
+    # chunk and a revived child (--resume) continues mid-campaign.
+    ckpt_dir = os.environ.get("STENCIL_BENCH_CKPT_DIR") or None
+    if ckpt_dir:
+        # per-config subdir: the 128^3 CPU fallback must never repoint
+        # LATEST or prune away the 512^3 accel campaign's snapshots
+        ckpt_dir = os.path.join(ckpt_dir, f"jacobi{n}")
     leg("jacobi3d headline")
     r = run(n, n, n, iters=3 * chunk, weak=False, devices=jax.devices()[:1],
-            warmup=1, chunk=chunk)
+            warmup=1, chunk=chunk,
+            ckpt_dir=ckpt_dir, ckpt_every=chunk if ckpt_dir else 0,
+            resume=resume and ckpt_dir is not None)
+    import math
+
+    if ckpt_dir and not math.isfinite(r["iter_trimean_s"]):
+        # the previous child finished this leg (snapshot at step==iters)
+        # but died before delivering the sentinel, so its timings are
+        # gone: a resume has nothing to time and would report a 0.0
+        # headline — re-measure fresh instead
+        print(f"[bench:{mode}] resume found the jacobi leg complete; "
+              "re-measuring", file=sys.stderr, flush=True)
+        r = run(n, n, n, iters=3 * chunk, weak=False,
+                devices=jax.devices()[:1], warmup=1, chunk=chunk,
+                ckpt_dir=ckpt_dir, ckpt_every=chunk, resume=False)
     mcells = r["mcells_per_s_per_dev"]
 
     # exchange benchmark: radius-3, 4 float quantities (exchange_weak config,
@@ -297,12 +324,20 @@ def main() -> int:
         archive_dir=os.environ.get("STENCIL_BENCH_LOG_DIR") or None,
     )
 
-    def child(mode: str, timeout_s: float, floor_s: float = 0.0):
+    def child(mode: str, timeout_s: float, floor_s: float = 0.0,
+              resume: bool = False):
         env = dict(os.environ)
         env["STENCIL_BENCH_LEG_BUDGET_S"] = str(max(60.0, timeout_s - 60.0))
+        # resume-on-revival: every rung after the first tells the child to
+        # continue from its last durable checkpoint (no-op without
+        # STENCIL_BENCH_CKPT_DIR; elastic restore skips an incompatible
+        # snapshot, so the smaller CPU fallback still starts clean)
+        cmd = [sys.executable, os.path.abspath(__file__), "--child", mode]
+        if resume:
+            cmd.append("--resume")
         return rev.attempt(
             f"bench-{mode}",
-            [sys.executable, os.path.abspath(__file__), "--child", mode],
+            cmd,
             timeout_s=timeout_s,
             heartbeat_timeout_s=heartbeat_s,
             env=env,
@@ -326,11 +361,12 @@ def main() -> int:
         timeout_s = min(timeout_s, max(10.0, rev.remaining() - reserve_cpu))
         if timeout_s < 10.0:
             continue  # not enough time to even import jax
-        payload = child(mode, timeout_s)
+        payload = child(mode, timeout_s, resume=i > 0)
         if payload is not None:
             print(json.dumps(payload), flush=True)
             return 0
-    payload = child("cpu", max(30.0, rev.remaining() - 5.0), floor_s=30.0)
+    payload = child("cpu", max(30.0, rev.remaining() - 5.0), floor_s=30.0,
+                    resume=True)
     if payload is not None:
         print(json.dumps(payload), flush=True)
         return 0
@@ -355,5 +391,6 @@ def main() -> int:
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
-        raise SystemExit(_child_main(sys.argv[2]))
+        raise SystemExit(_child_main(sys.argv[2],
+                                     resume="--resume" in sys.argv[3:]))
     raise SystemExit(main())

@@ -327,6 +327,98 @@ class DistributedDomain:
         itemsizes = [jnp.dtype(dt).itemsize for dt in self._dtypes]
         return self._exchange.bytes_moved(itemsizes)
 
+    # -- checkpoint / restart (ckpt/ subsystem) ------------------------------
+    def save_checkpoint(self, ckpt_dir: str, step: int, *, keep: int = 3,
+                        asynchronous: bool = True) -> None:
+        """Snapshot every quantity's ``curr`` state at ``step`` into
+        ``ckpt_dir`` (sharded per-block npz + manifest; crash-safe rename
+        protocol — see ckpt/snapshot.py).
+
+        ``asynchronous=True`` (default) fetches the snapshot copy on this
+        thread, then hashes/serializes/fsyncs on a writer thread so the
+        step loop keeps running; a second save drains the first (double
+        buffering). Call :meth:`finish_checkpoints` before exiting."""
+        from .ckpt import AsyncCheckpointer, host_snapshot, write_snapshot
+
+        if jax.process_count() > 1:
+            # cross-host shards are not addressable from this process;
+            # per-host sharded writes + manifest merge are a ROADMAP #7
+            # follow-up — degrade loudly, never kill the campaign
+            log.warn("ckpt: multi-process checkpoint writes are not "
+                     "supported yet; skipping save")
+            return
+        arrays = {name: self._curr[i] for i, name in enumerate(self._names)}
+        dtypes = dict(zip(self._names, self._dtypes))
+        if not asynchronous:
+            with timer.timed("ckpt.save"), timer.trace_range("ckpt.save"):
+                write_snapshot(ckpt_dir, step, self.spec,
+                               host_snapshot(self.spec, arrays),
+                               dtypes=dtypes, keep=keep)
+            return
+        cp = getattr(self, "_checkpointer", None)
+        if cp is None or cp.ckpt_dir != ckpt_dir:
+            if cp is not None:
+                cp.close()
+            cp = self._checkpointer = AsyncCheckpointer(
+                ckpt_dir, keep=keep, dtypes=dtypes
+            )
+        cp.keep = keep
+        cp.save(self.spec, arrays, step)
+
+    def finish_checkpoints(self) -> None:
+        """Drain the async checkpoint writer (every handed-off snapshot is
+        durable when this returns)."""
+        cp = getattr(self, "_checkpointer", None)
+        if cp is not None:
+            cp.close()
+            self._checkpointer = None
+
+    def restore_checkpoint(self, ckpt_dir: str) -> Optional[int]:
+        """Materialize the newest valid snapshot under ``ckpt_dir`` onto
+        THIS domain — elastic: the snapshot's partition/mesh/device count
+        may differ from the saver's (global reassembly + re-split + halo
+        exchange; ckpt/restore.py). Returns the restored step, or None
+        when no compatible snapshot exists (logged, never raised — the
+        auto-resume path must degrade to a fresh start)."""
+        from .ckpt import assemble_global, check_compatible, find_resume
+        from .obs import telemetry
+
+        assert self._realized, "restore_checkpoint requires realize()"
+        if jax.process_count() > 1:
+            log.warn("ckpt: multi-process restore is not supported yet; "
+                     "starting fresh")
+            return None
+        # compatibility joins validity in the fallback: a newer intact
+        # snapshot from a DIFFERENT domain shape must not shadow an older
+        # compatible one
+        found = find_resume(
+            ckpt_dir,
+            accept=lambda m: check_compatible(
+                m, self.size, self._names, self._dtypes),
+        )
+        if found is None:
+            log.info(f"ckpt: no valid compatible snapshot under {ckpt_dir}")
+            return None
+        snap, manifest = found
+        rec = telemetry.get()
+        with rec.span("ckpt.restore", phase="ckpt", step=manifest["step"]):
+            nbytes = 0
+            for idx, name in enumerate(self._names):
+                g = assemble_global(snap, manifest, name,
+                                    dtype=self._dtypes[idx])
+                nbytes += g.nbytes
+                self.set_curr_global(DataHandle(idx, name, self._dtypes[idx]), g)
+            if self.radius.max_radius() > 0:
+                # rebuild every exterior on the CURRENT partition — after
+                # this the restored state is indistinguishable from a live
+                # one (halo exchange is idempotent on exchanged data)
+                self.exchange()
+        rec.counter("ckpt.bytes_read", bytes=nbytes, phase="ckpt",
+                    step=manifest["step"])
+        rec.meta("ckpt.resumed", step=manifest["step"], snapshot=snap)
+        log.info(f"ckpt: restored step {manifest['step']} from {snap}")
+        return manifest["step"]
+
     # -- observability -------------------------------------------------------
     def write_plan(self, prefix: str) -> None:
         """Dump the exchange plan and the block-comm matrix — the analogue of

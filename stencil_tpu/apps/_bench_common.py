@@ -55,6 +55,34 @@ def start_metrics(args, app: str) -> "telemetry.Recorder":
     )
 
 
+def finish_metrics(rec: "telemetry.Recorder") -> None:
+    """The apps' shared exit epilogue: snapshot the global timer buckets
+    as gauges and close the sink (no-op on a disabled recorder)."""
+    if rec.enabled:
+        rec.record_timer_buckets()
+        rec.close()
+
+
+def resume_from_checkpoint(dd, ckpt_dir: str, iters: int) -> int:
+    """The apps' shared resume policy (jacobi3d, astaroth): restore the
+    newest valid compatible snapshot, warn when it is beyond the run's
+    target (and never re-label it — step accounting stays truthful),
+    record the resumed-from-step gauge, and return the start step
+    (0 = fresh start)."""
+    from ..utils import logging as log
+
+    restored = dd.restore_checkpoint(ckpt_dir)
+    if restored is None:
+        return 0
+    if restored > iters:
+        log.warn(f"checkpoint step {restored} is beyond the target {iters}; "
+                 "nothing to run and the snapshot is NOT relabeled")
+    start = min(restored, iters)
+    telemetry.get().gauge("ckpt.resumed_from_step", start, phase="ckpt")
+    log.info(f"resuming from checkpointed step {start}")
+    return start
+
+
 def coord_state(dd, quantities: int):
     """Deterministic per-quantity coordinate fields on a realized domain
     (value = z*1e6 + y*1e3 + x + quantity index) — the bit-for-bit

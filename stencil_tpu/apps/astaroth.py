@@ -76,6 +76,10 @@ def run(
     chunk: int = 1,
     kernel_variant: Optional[str] = None,
     metrics_dma: bool = False,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: int = 0,
+    ckpt_keep: int = 3,
+    resume: bool = False,
 ) -> dict:
     """Run ``iters`` iterations (plus one untimed warmup chunk) and return
     timing stats + the domain.
@@ -171,6 +175,23 @@ def run(
     if paraview_init:
         dd.write_paraview("init")
 
+    # checkpoint/restart (ckpt/): the 8 fields' per-block interiors are the
+    # durable campaign state; resume elastically replaces the fresh init
+    start = 0
+    if ckpt_dir and no_compute:
+        log.warn("--ckpt-dir ignored with --no-compute (pure-exchange "
+                 "benchmark has no campaign state worth resuming)")
+        ckpt_dir = None
+    if ckpt_dir and resume:
+        from ._bench_common import resume_from_checkpoint
+
+        start = resume_from_checkpoint(dd, ckpt_dir, iters)
+
+    def save_ckpt(step: int, state) -> None:
+        for name in FIELDS:
+            dd.set_curr(handles[name], state[name])
+        dd.save_checkpoint(ckpt_dir, step, keep=ckpt_keep)
+
     curr = {name: dd.get_curr(handles[name]) for name in FIELDS}
     nxt = {name: dd.get_next(handles[name]) for name in FIELDS}
 
@@ -205,8 +226,16 @@ def run(
             kernel_variant=kernel_variant,
         )
         with rec.span("astaroth.warmup", phase="compile", iters=chunk):
-            curr, nxt = step(curr, nxt)  # compile + warm (one chunk)
-            hard_sync(curr)
+            if ckpt_dir:
+                # step-exact contract for checkpointed runs: warm the
+                # compile caches on throwaway copies (the step donates its
+                # inputs), never advancing the real state
+                step(jax.tree.map(lambda a: a + 0, curr),
+                     jax.tree.map(lambda a: a + 0, nxt))
+                hard_sync(curr)
+            else:
+                curr, nxt = step(curr, nxt)  # compile + warm (one chunk)
+                hard_sync(curr)
         # The exchange share can't be timed inside the fused step, so it is
         # measured as a standalone loop on the same state each iteration
         # (halo exchange is idempotent on exchanged data, so this does not
@@ -220,7 +249,9 @@ def run(
         curr = exch_loop(curr)
         hard_sync(curr)
 
-        done = 0
+        done = start
+        next_ckpt = (start // ckpt_every + 1) * ckpt_every if (
+            ckpt_dir and ckpt_every > 0) else None
         while done < iters:
             t0 = time.perf_counter()
             curr, nxt = step(curr, nxt)
@@ -231,6 +262,9 @@ def run(
             rec.emit("span", "astaroth.iter", phase="step", seconds=per,
                      iters=chunk)
             done += chunk
+            if next_ckpt is not None and done >= next_ckpt and done < iters:
+                save_ckpt(done, curr)
+                next_ckpt = (done // ckpt_every + 1) * ckpt_every
             t0 = time.perf_counter()
             curr = exch_loop(curr)
             hard_sync(curr)
@@ -238,6 +272,22 @@ def run(
             exch_time.insert(ex_dt)
             rec.emit("span", "astaroth.exchange", phase="exchange",
                      seconds=ex_dt, iters=n_ex)
+        if ckpt_dir:
+            if done > start or start == 0:
+                save_ckpt(done, curr)  # the final state is always durable
+            # a resume that found nothing left to run never re-labels the
+            # existing (possibly further-along) snapshot
+            dd.finish_checkpoints()
+
+    timed_iters = iter_time.count()
+    if iter_time.count() == 0:
+        # resumed at/past the target iteration count: nothing left to time
+        # (inf placeholder; non-finite gauges are skipped — they would
+        # serialize as non-strict JSON)
+        log.info(f"resume found step {start} >= iters {iters}; no timed work")
+        iter_time.insert(float("inf"))
+    if exch_time.count() == 0:
+        exch_time.insert(float("inf"))
 
     if rec.enabled:
         # compile-time truth of this method's exchange (on-wire volume)
@@ -259,10 +309,12 @@ def run(
             else:
                 rec.meta("dma.skipped",
                          reason="pallas fused substep not engaged")
-        rec.gauge("astaroth.iter_trimean_s", iter_time.trimean(),
-                  phase="step", unit="s")
-        rec.gauge("astaroth.exch_trimean_s", exch_time.trimean(),
-                  phase="exchange", unit="s")
+        if np.isfinite(iter_time.trimean()):
+            rec.gauge("astaroth.iter_trimean_s", iter_time.trimean(),
+                      phase="step", unit="s")
+        if np.isfinite(exch_time.trimean()):
+            rec.gauge("astaroth.exch_trimean_s", exch_time.trimean(),
+                      phase="exchange", unit="s")
 
     for name in FIELDS:
         dd.set_curr(handles[name], curr[name])
@@ -281,7 +333,7 @@ def run(
         "global": size,
         "iter_trimean_s": iter_time.trimean(),
         "exch_trimean_s": exch_time.trimean(),
-        "iters_run": iter_time.count(),
+        "iters_run": timed_iters,
         "domain": dd,
         "handles": handles,
         "info": info,
@@ -335,6 +387,17 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--chunk", type=int, default=1,
                    help="iterations fused per dispatch (benchmarking; a "
                         "final partial chunk still runs a full chunk)")
+    p.add_argument("--ckpt-dir", type=str, default="",
+                   help="write elastic checkpoint snapshots here (ckpt/ "
+                        "subsystem: sharded npz + manifest, crash-safe)")
+    p.add_argument("--ckpt-every", type=int, default=0,
+                   help="checkpoint every N iterations (0 = only the final "
+                        "state; needs --ckpt-dir)")
+    p.add_argument("--ckpt-keep", type=int, default=3,
+                   help="retention: keep the newest N snapshots")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest valid snapshot under "
+                        "--ckpt-dir when one exists (fresh start otherwise)")
     p.add_argument("--cpu", type=int, default=0)
     from ._bench_common import add_metrics_flags, start_metrics
     add_metrics_flags(p, dma=True)
@@ -369,6 +432,10 @@ def main(argv: Optional[list] = None) -> int:
         chunk=args.chunk,
         kernel_variant=args.kernel_variant,
         metrics_dma=args.metrics_dma and rec.enabled,
+        ckpt_dir=args.ckpt_dir or None,
+        ckpt_every=args.ckpt_every,
+        ckpt_keep=args.ckpt_keep,
+        resume=args.resume,
     )
     print(csv_row(r))
     log.info(timer.report())

@@ -130,13 +130,20 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--rounds", type=int, default=3)
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
+    from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    rec = start_metrics(args, "bench_alltoall")
     sizes = tuple(int(s) for s in args.sizes_kb.split(","))
     for r in run(sizes_kb=sizes):
         print(csv_row(r))
+        rec.gauge("bench_alltoall.gb_per_s", r["gb_per_s"], phase="exchange",
+                  strategy=r["strategy"], bytes=r["bytes_per_pair"],
+                  devices=r["devices"])
+    finish_metrics(rec)
     return 0
 
 

@@ -81,14 +81,20 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--radius", type=int, default=3)
     p.add_argument("--iters", type=int, default=50)
     p.add_argument("--cpu", type=int, default=0)
+    from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    rec = start_metrics(args, "bench_pack")
     print("dir,bytes,s/op,GB/s")
     for row in run(args.x, args.y, args.z, radius=args.radius, iters=args.iters):
         d = row["dir"]
         print(f"({d[0]} {d[1]} {d[2]}),{row['bytes']},{row['s_per_op']:e},{row['gb_per_s']:.2f}")
+        rec.gauge("bench_pack.gb_per_s", row["gb_per_s"], phase="compute",
+                  dir=f"{d[0]},{d[1]},{d[2]}", bytes=row["bytes"])
+    finish_metrics(rec)
     return 0
 
 

@@ -16,6 +16,7 @@ Usage: python -m stencil_tpu.apps.jacobi3d --x 512 --y 512 --z 512 --iters 5
 from __future__ import annotations
 
 import argparse
+import os
 import time
 from typing import Optional
 
@@ -58,7 +59,7 @@ def run(
     devices=None,
     weak: bool = True,
     paraview: bool = False,
-    checkpoint_period: int = -1,
+    paraview_every: int = -1,
     prefix: str = "",
     partition=None,
     warmup: int = 1,
@@ -66,6 +67,10 @@ def run(
     deep_halo: int = 1,
     multistep_rows: Optional[int] = None,
     metrics_dma: bool = False,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: int = 0,
+    ckpt_keep: int = 3,
+    resume: bool = False,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -129,8 +134,29 @@ def run(
     if paraview:
         dd.write_paraview(prefix + "jacobi3d_init")
 
+    # checkpoint/restart (ckpt/): resume replaces the fresh init with the
+    # newest durable snapshot's state — elastically, so a run revived on a
+    # different partition/device count continues the same campaign
+    start = 0
+    if ckpt_dir and resume:
+        from ._bench_common import resume_from_checkpoint
+
+        start = resume_from_checkpoint(dd, ckpt_dir, iters)
+    kill_after = int(os.environ.get("STENCIL_CKPT_KILL_AFTER_SAVE", "-1") or -1)
+
+    def save_ckpt(step: int, state) -> None:
+        dd.set_curr(h, state)
+        dd.save_checkpoint(ckpt_dir, step, keep=ckpt_keep)
+        if 0 <= kill_after <= step:
+            # injected-kill hook (CI checkpoint gate / tests): die hard
+            # right after this snapshot is durable — the revival must
+            # continue from it, not from step 0
+            dd.finish_checkpoints()
+            log.warn(f"STENCIL_CKPT_KILL_AFTER_SAVE: dying after step {step}")
+            os._exit(17)
+
     curr, nxt = dd.get_curr(h), dd.get_next(h)
-    stepwise = paraview and checkpoint_period > 0
+    stepwise = paraview and paraview_every > 0
     if chunk is None:
         chunk = 1 if stepwise else min(iters, 10)
     chunk = min(chunk, iters)
@@ -153,12 +179,36 @@ def run(
             )
         return loops[k]
 
-    loop = get_loop(chunk)
+    # The exact fused-chunk sizes the measured loop will dispatch
+    # (checkpoint boundaries clamp them): ONE schedule drives both warmup
+    # and the timed loop, so warmup compiles precisely what runs and no
+    # XLA compile can land inside a timed region.
+    plan, d = [], start
+    while d < iters:
+        k = min(chunk, iters - d)
+        if ckpt_dir and ckpt_every > 0:
+            k = min(k, ckpt_every - d % ckpt_every)
+        plan.append(k)
+        d += k
+
     with rec.span("jacobi.warmup", phase="compile", iters=warmup * chunk):
-        for _ in range(warmup):  # compile + warm caches, excluded from timing
-            curr, nxt = loop(curr, nxt, sel)
-        if warmup:
-            hard_sync(curr)
+        if ckpt_dir:
+            # checkpointed runs are step-exact by contract (save at k,
+            # resume, continue to n == uninterrupted n): warm the compile
+            # caches on throwaway copies so warmup never advances the
+            # state (the loops donate their inputs, so fresh buffers are
+            # needed anyway) — one throwaway call per distinct chunk size
+            # in the plan
+            if warmup:
+                for k in dict.fromkeys(plan):
+                    get_loop(k)(curr + 0, nxt + 0, sel)
+                hard_sync(curr)
+        else:
+            loop = get_loop(chunk)
+            for _ in range(warmup):  # compile + warm caches, excluded from timing
+                curr, nxt = loop(curr, nxt, sel)
+            if warmup:
+                hard_sync(curr)
 
     # Iterations run in fused chunks: one dispatch + one hard sync per chunk
     # (block_until_ready is unreliable and per-call dispatch is ~0.7 s on the
@@ -167,9 +217,8 @@ def run(
     # per-iter times (bin/jacobi3d.cu:370-372). A short final chunk keeps the
     # total at exactly `iters`.
     iter_time = Statistics()
-    done = 0
-    while done < iters:
-        k = min(chunk, iters - done)
+    done = start
+    for k in plan:
         fn = get_loop(k)
         t0 = time.perf_counter()
         curr, nxt = fn(curr, nxt, sel)
@@ -178,9 +227,22 @@ def run(
         iter_time.insert(per)
         rec.emit("span", "jacobi.iter", phase="step", seconds=per, iters=k)
         done += k
-        if stepwise and done % checkpoint_period == 0:
+        if (ckpt_dir and ckpt_every > 0 and done < iters
+                and done % ckpt_every == 0):
+            save_ckpt(done, curr)
+        if stepwise and done % paraview_every == 0:
             dd.set_curr(h, curr)
             dd.write_paraview(f"{prefix}jacobi3d_{done}")
+    if ckpt_dir:
+        if done > start or start == 0:
+            # the final state is always durable (step == iters), so a
+            # revived campaign that already finished resumes directly to
+            # the report
+            save_ckpt(iters, curr)
+        # resumed past the end without stepping: the durable snapshot
+        # already covers (and may EXCEED) this run's target — re-labeling
+        # it as step `iters` would corrupt the campaign's step accounting
+        dd.finish_checkpoints()
     if rec.enabled:
         # per-phase split + the compiled programs' static truth. The step
         # fuses exchange+compute, so the exchange share is measured as a
@@ -233,6 +295,12 @@ def run(
         dd.write_paraview(prefix + "jacobi3d_final")
 
     cells = size.flatten()
+    if iter_time.count() == 0:
+        # resumed at/past the target step: nothing left to time (the inf
+        # placeholder keeps downstream ratios at 0, and gauges that would
+        # serialize as non-strict JSON are skipped below)
+        log.info(f"resume found step {start} >= iters {iters}; no timed work")
+        iter_time.insert(float("inf"))
     trimean = iter_time.trimean()
     result = {
         "app": "jacobi3d",
@@ -255,7 +323,9 @@ def run(
         rec.gauge("jacobi.mcells_per_s", result["mcells_per_s"], phase="step")
         rec.gauge("jacobi.mcells_per_s_per_dev",
                   result["mcells_per_s_per_dev"], phase="step")
-        rec.gauge("jacobi.iter_trimean_s", trimean, phase="step", unit="s")
+        if np.isfinite(trimean):  # inf would serialize as non-strict JSON
+            rec.gauge("jacobi.iter_trimean_s", trimean, phase="step",
+                      unit="s")
         rec.counter("jacobi.exchange_bytes", bytes=result["exchange_bytes"],
                     phase="exchange", method=method.value)
     return result
@@ -285,7 +355,23 @@ def main(argv: Optional[list] = None) -> int:
                         "overrides --direct26)")
     p.add_argument("--no-weak", action="store_true", help="fixed total domain (strong)")
     p.add_argument("--paraview", action="store_true")
-    p.add_argument("--checkpoint-period", type=int, default=-1)
+    p.add_argument("--paraview-every", type=int, default=-1,
+                   help="with --paraview, also dump every N iterations")
+    p.add_argument("--checkpoint-period", type=int, default=None,
+                   help="DEPRECATED alias of --paraview-every (it was always "
+                        "a ParaView dump cadence; real checkpointing is "
+                        "--ckpt-dir/--ckpt-every)")
+    p.add_argument("--ckpt-dir", type=str, default="",
+                   help="write elastic checkpoint snapshots here (ckpt/ "
+                        "subsystem: sharded npz + manifest, crash-safe)")
+    p.add_argument("--ckpt-every", type=int, default=0,
+                   help="checkpoint every N iterations (0 = only the final "
+                        "state; needs --ckpt-dir)")
+    p.add_argument("--ckpt-keep", type=int, default=3,
+                   help="retention: keep the newest N snapshots")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest valid snapshot under "
+                        "--ckpt-dir when one exists (fresh start otherwise)")
     p.add_argument("--prefix", type=str, default="")
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
     p.add_argument("--deep-halo", type=int, default=1,
@@ -307,6 +393,14 @@ def main(argv: Optional[list] = None) -> int:
         jax.config.update("jax_num_cpu_devices", args.cpu)
     rec = start_metrics(args, "jacobi3d")
 
+    paraview_every = args.paraview_every
+    if args.checkpoint_period is not None:
+        log.warn("--checkpoint-period is deprecated (it names a ParaView "
+                 "dump cadence, not a checkpoint): use --paraview-every; "
+                 "checkpoints are --ckpt-dir/--ckpt-every")
+        if paraview_every < 0:
+            paraview_every = args.checkpoint_period
+
     r = run(
         args.x,
         args.y,
@@ -318,11 +412,15 @@ def main(argv: Optional[list] = None) -> int:
         devices=jax.devices()[: args.cpu] if args.cpu else None,
         weak=not args.no_weak,
         paraview=args.paraview,
-        checkpoint_period=args.checkpoint_period,
+        paraview_every=paraview_every,
         prefix=args.prefix,
         deep_halo=args.deep_halo,
         multistep_rows=args.multistep_rows,
         metrics_dma=args.metrics_dma and rec.enabled,
+        ckpt_dir=args.ckpt_dir or None,
+        ckpt_every=args.ckpt_every,
+        ckpt_keep=args.ckpt_keep,
+        resume=args.resume,
     )
     print(csv_row(r))
     log.info(f"mcells/s = {r['mcells_per_s']:.1f} ({r['mcells_per_s_per_dev']:.1f}/device)")

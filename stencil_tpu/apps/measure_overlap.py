@@ -182,10 +182,13 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--trace", type=str, default="",
                    help="write a jax.profiler trace of one overlapped chunk here")
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
+    from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    rec = start_metrics(args, "measure_overlap")
     r = run(
         args.x, args.y, args.z,
         radius=args.radius,
@@ -202,6 +205,10 @@ def main(argv: Optional[list] = None) -> int:
         f"{r['hidden_s']*1e3:.2f} ms ({r['hidden_frac']*100:.0f}% of exchange)"
     )
     log.info(timer.report())
+    for key in ("compute_s", "exchange_s", "serial_s", "overlap_s", "hidden_s"):
+        rec.gauge(f"overlap.{key}", r[key], phase="step", unit="s")
+    rec.gauge("overlap.hidden_frac", r["hidden_frac"], phase="step")
+    finish_metrics(rec)
     return 0
 
 

@@ -79,13 +79,21 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--max-bytes", type=int, default=1 << 24)
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--cpu", type=int, default=0)
+    from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
+    add_metrics_flags(p)
     args = p.parse_args(argv)
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+    rec = start_metrics(args, "pingpong")
     print("bytes,latency (us),GB/s")
     for row in run(args.min_bytes, args.max_bytes, args.iters):
         print(f"{row['bytes']},{row['latency_us']:.2f},{row['gb_per_s']:.3f}")
+        rec.gauge("pingpong.latency_us", row["latency_us"], phase="exchange",
+                  unit="us", bytes=row["bytes"])
+        rec.gauge("pingpong.gb_per_s", row["gb_per_s"], phase="exchange",
+                  bytes=row["bytes"])
+    finish_metrics(rec)
     return 0
 
 

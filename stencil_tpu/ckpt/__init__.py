@@ -1,0 +1,32 @@
+"""Elastic checkpoint/restart for distributed grid state.
+
+- :mod:`snapshot`: per-block sharded snapshots with a JSON manifest,
+  crash-safe rename protocol, retention, async double-buffered writes.
+- :mod:`restore`: validation + elastic restore onto a different
+  partition/mesh (global reassembly, re-split, halo exchange).
+
+The user-facing surface is ``DistributedDomain.save_checkpoint`` /
+``restore_checkpoint`` (api.py) and ``apps/ckpt_tool.py``.
+"""
+
+from .snapshot import (  # noqa: F401
+    LATEST_NAME,
+    MANIFEST_NAME,
+    MANIFEST_VERSION,
+    AsyncCheckpointer,
+    host_snapshot,
+    list_snapshots,
+    prune,
+    read_latest,
+    snapshot_name,
+    step_of,
+    write_snapshot,
+)
+from .restore import (  # noqa: F401
+    assemble_global,
+    check_compatible,
+    find_resume,
+    load_manifest,
+    validate_manifest,
+    validate_snapshot,
+)

@@ -1,0 +1,404 @@
+"""Sharded, crash-safe snapshots of distributed grid state.
+
+The write side of the elastic checkpoint/restart subsystem (restore.py is
+the read side). Multi-level checkpoint/restart in the spirit of SCR
+(Moody et al., SC'10) and the async sharded-manifest design of Orbax —
+but *grid-shaped*: the durable unit is the per-block compute interior, so
+a snapshot taken on one partition can be restored onto any other
+(restore.py reassembles the global interior and re-splits it).
+
+What one snapshot ``<ckpt_dir>/step-<k>/`` contains:
+
+- ``block_z_y_x.npz`` per partition block: one array per quantity holding
+  that block's compute interior (no halos, no alignment pad — halos are
+  rebuilt by the halo exchange after restore, exactly like fresh state).
+- ``manifest.json``: schema version, step, global/partition geometry,
+  radius, quantity names + dtypes, and per-file byte counts + SHA-256 —
+  the integrity authority ``ckpt_tool validate`` and auto-resume check.
+
+Crash-safety discipline (the SCR/Orbax rename protocol):
+
+1. payloads + manifest are written into ``<ckpt_dir>/.tmp-...`` and every
+   file is fsync'd;
+2. the tmp dir is atomically renamed to ``step-<k>`` and the parent
+   directory fsync'd — a crash before this leaves only a ``.tmp-`` dir
+   that restore ignores;
+3. only then is the ``LATEST`` pointer replaced (tmp + atomic rename), so
+   ``LATEST`` can never name a partial snapshot;
+4. retention prunes the oldest snapshots beyond ``keep``, never the one
+   ``LATEST`` names.
+
+:class:`AsyncCheckpointer` double-buffers the write: the device_get
+snapshot copy happens on the caller's thread (cheap, and it must — the
+step loop donates its buffers), then hashing/serialization/fsync run on a
+writer thread while the step loop keeps running. At most one write is in
+flight; a second save drains the first (double buffering, not an
+unbounded queue).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import logging as log
+
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+LATEST_NAME = "LATEST"
+PAYLOAD_FORMAT = "npz-v1"
+_TMP_PREFIX = ".tmp-"
+
+
+def snapshot_name(step: int) -> str:
+    return f"step-{step:08d}"
+
+
+def step_of(name: str) -> Optional[int]:
+    """Parse a snapshot dir name back to its step (None if not one)."""
+    base = os.path.basename(os.path.normpath(name))
+    if not base.startswith("step-"):
+        return None
+    try:
+        return int(base[len("step-"):], 10)
+    except ValueError:
+        return None
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # e.g. a platform without O_RDONLY dirs; rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _radius_dirs(radius) -> List[List[int]]:
+    """Serialize a Radius as [[dx,dy,dz,r], ...] (saver-side record only —
+    restore uses the *target* domain's radius)."""
+    return [[d[0], d[1], d[2], r] for d, r in sorted(radius._r.items())]
+
+
+def host_snapshot(spec, arrays: Dict[str, "object"]) -> Dict[str, np.ndarray]:
+    """The device_get side of a save: fetch each stacked quantity to host
+    memory. This is the "snapshot copy" handed to the writer thread — after
+    it returns, the step loop may donate/overwrite the device buffers."""
+    import jax
+
+    return {name: np.asarray(jax.device_get(a)) for name, a in arrays.items()}
+
+
+def write_snapshot(
+    ckpt_dir: str,
+    step: int,
+    spec,
+    host_state: Dict[str, np.ndarray],
+    dtypes: Optional[Dict[str, str]] = None,
+    keep: int = 3,
+    extra_meta: Optional[dict] = None,
+) -> str:
+    """Write one durable snapshot; returns the final snapshot directory.
+
+    ``host_state`` maps quantity name -> host copy of the stacked array
+    (``(bz,by,bx,pz,py,px)``, see :func:`host_snapshot`). ``dtypes`` pins
+    the manifest dtype per quantity (defaults to each array's dtype).
+    """
+    from ..obs import telemetry
+
+    rec = telemetry.get()
+    t0 = time.perf_counter()
+    os.makedirs(ckpt_dir, exist_ok=True)
+    final = os.path.join(ckpt_dir, snapshot_name(step))
+    tmp = os.path.join(ckpt_dir, f"{_TMP_PREFIX}{snapshot_name(step)}-{os.getpid()}")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    off = spec.compute_offset()
+    names = sorted(host_state)
+    files = []
+    total_bytes = 0
+    for iz in range(spec.dim.z):
+        for iy in range(spec.dim.y):
+            for ix in range(spec.dim.x):
+                o = spec.block_origin((ix, iy, iz))
+                s = spec.block_size((ix, iy, iz))
+                payload = {}
+                for name in names:
+                    arr = host_state[name]
+                    payload[name] = np.ascontiguousarray(
+                        arr[
+                            iz, iy, ix,
+                            off.z : off.z + s.z,
+                            off.y : off.y + s.y,
+                            off.x : off.x + s.x,
+                        ]
+                    )
+                fname = f"block_{iz}_{iy}_{ix}.npz"
+                fpath = os.path.join(tmp, fname)
+                with open(fpath, "wb") as f:
+                    np.savez(f, **payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                nbytes = os.path.getsize(fpath)
+                total_bytes += nbytes
+                files.append(
+                    {
+                        "path": fname,
+                        "bytes": nbytes,
+                        "sha256": _sha256(fpath),
+                        "block": [ix, iy, iz],
+                        "origin": [o.x, o.y, o.z],
+                        "size": [s.x, s.y, s.z],
+                    }
+                )
+
+    g, d = spec.global_size, spec.dim
+    manifest = {
+        "v": MANIFEST_VERSION,
+        "kind": "stencil-ckpt",
+        "payload": PAYLOAD_FORMAT,
+        "step": int(step),
+        "written_t": time.time(),
+        "global": {"x": g.x, "y": g.y, "z": g.z},
+        "partition": {"x": d.x, "y": d.y, "z": d.z},
+        "radius": _radius_dirs(spec.radius),
+        "quantities": [
+            {
+                "name": name,
+                "dtype": str((dtypes or {}).get(name, host_state[name].dtype)),
+            }
+            for name in names
+        ],
+        "files": files,
+    }
+    if extra_meta:
+        manifest["meta"] = extra_meta
+    mpath = os.path.join(tmp, MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+
+    # atomic publish: rename the complete dir into place, then the pointer.
+    # An existing snapshot of the same step is MOVED aside first (rename,
+    # not rmtree): deleting it before the replacement lands would reopen
+    # the exact crash window the rename protocol closes — a kill between
+    # the two renames leaves the old state on disk (as an ignored .tmp-
+    # dir) instead of losing the newest durable step outright.
+    displaced = None
+    if os.path.isdir(final):
+        displaced = os.path.join(
+            ckpt_dir, f"{_TMP_PREFIX}{snapshot_name(step)}-old-{os.getpid()}"
+        )
+        if os.path.isdir(displaced):
+            shutil.rmtree(displaced)
+        os.rename(final, displaced)
+    os.rename(tmp, final)
+    _fsync_dir(ckpt_dir)
+    if displaced is not None:
+        shutil.rmtree(displaced, ignore_errors=True)
+    _write_latest(ckpt_dir, snapshot_name(step))
+    prune(ckpt_dir, keep=keep)
+
+    rec.emit("span", "ckpt.write", phase="ckpt",
+             seconds=time.perf_counter() - t0, step=int(step))
+    rec.counter("ckpt.bytes_written", bytes=total_bytes, phase="ckpt",
+                step=int(step))
+    rec.counter("ckpt.files_written", value=len(files), phase="ckpt",
+                step=int(step))
+    log.debug(f"checkpoint step {step}: {len(files)} files, "
+              f"{total_bytes} bytes -> {final}")
+    return final
+
+
+def _write_latest(ckpt_dir: str, name: str) -> None:
+    tmp = os.path.join(ckpt_dir, f"{_TMP_PREFIX}LATEST-{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(name + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(ckpt_dir, LATEST_NAME))
+    _fsync_dir(ckpt_dir)
+
+
+def read_latest(ckpt_dir: str) -> Optional[str]:
+    """The snapshot name ``LATEST`` points at (None when absent/empty)."""
+    try:
+        with open(os.path.join(ckpt_dir, LATEST_NAME)) as f:
+            name = f.read().strip()
+    except OSError:
+        return None
+    return name or None
+
+
+def list_snapshots(ckpt_dir: str) -> List[str]:
+    """Snapshot dir names under ``ckpt_dir``, oldest step first. Tmp dirs
+    (in-flight or crashed writes) are never listed."""
+    try:
+        entries = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    out = [
+        e for e in entries
+        if step_of(e) is not None and os.path.isdir(os.path.join(ckpt_dir, e))
+    ]
+    return sorted(out, key=step_of)
+
+
+def prune(ckpt_dir: str, keep: int) -> List[str]:
+    """Delete the oldest snapshots beyond ``keep`` (``keep <= 0`` keeps
+    everything); never the one LATEST names. Stale ``.tmp-`` leftovers
+    from crashed writers (dirs AND files — the LATEST tmp is a file) are
+    garbage-collected either way. Returns the removed snapshot names."""
+    removed: List[str] = []
+    if keep > 0:
+        snaps = list_snapshots(ckpt_dir)
+        latest = read_latest(ckpt_dir)
+        excess = len(snaps) - keep
+        for name in snaps:
+            if excess <= 0:
+                break
+            if name == latest:
+                continue
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+            removed.append(name)
+            excess -= 1
+    for e in os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else []:
+        if e.startswith(_TMP_PREFIX):
+            p = os.path.join(ckpt_dir, e)
+            try:
+                age = time.time() - os.stat(p).st_mtime
+            except OSError:
+                continue
+            if age > 3600:  # only stale ones: a live writer owns recent tmps
+                if os.path.isdir(p):
+                    shutil.rmtree(p, ignore_errors=True)
+                else:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+    return removed
+
+
+class AsyncCheckpointer:
+    """Double-buffered asynchronous snapshot writer.
+
+    ``save(spec, arrays, step)`` fetches the device state to host on the
+    caller's thread (the snapshot copy — after that the step loop may
+    donate the buffers) and hands it to a writer thread. At most one write
+    is in flight; a save issued while one is pending blocks until the
+    previous write is durable (double buffering). ``flush()`` waits for
+    the in-flight write; ``close()`` flushes and stops the thread.
+
+    A failed write is logged + recorded as telemetry and re-raised from
+    the *next* ``save``/``flush``/``close`` — checkpointing must never
+    tear down the step loop mid-flight, but persistent failure must not
+    stay silent either.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: int = 3,
+                 dtypes: Optional[Dict[str, str]] = None):
+        self.ckpt_dir = ckpt_dir
+        self.keep = keep
+        self.dtypes = dict(dtypes or {})
+        self._pending: Optional[tuple] = None
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._stop = False
+        self.last_step: Optional[int] = None
+        self._thread = threading.Thread(
+            target=self._run, name="stencil-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while self._pending is None and not self._stop:
+                    self._work.wait()
+                if self._pending is None and self._stop:
+                    return
+                spec, host_state, step = self._pending
+            try:
+                write_snapshot(
+                    self.ckpt_dir, step, spec, host_state,
+                    dtypes=self.dtypes, keep=self.keep,
+                )
+                err = None
+            except BaseException as e:  # surfaced on the next save/flush
+                err = e
+            with self._lock:
+                if err is None:
+                    self.last_step = step
+                else:
+                    self._error = err
+                    log.warn(f"async checkpoint write failed: {err}")
+                self._pending = None
+                self._idle.notify_all()
+
+    def _raise_pending_error(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def save(self, spec, arrays: Dict[str, "object"], step: int) -> None:
+        """Snapshot ``arrays`` (name -> stacked device array) at ``step``."""
+        from ..obs import telemetry
+
+        with telemetry.get().span("ckpt.save", phase="ckpt", step=int(step)):
+            host_state = host_snapshot(spec, arrays)
+            with self._lock:
+                while self._pending is not None:
+                    self._idle.wait()
+                self._raise_pending_error()
+                self._pending = (spec, host_state, step)
+                self._work.notify()
+
+    def flush(self) -> None:
+        """Block until the in-flight write (if any) is durable."""
+        with self._lock:
+            while self._pending is not None:
+                self._idle.wait()
+            self._raise_pending_error()
+
+    def close(self) -> None:
+        with self._lock:
+            while self._pending is not None:
+                self._idle.wait()
+            self._stop = True
+            self._work.notify()
+        self._thread.join(timeout=60)
+        with self._lock:
+            self._raise_pending_error()

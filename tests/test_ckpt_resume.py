@@ -1,0 +1,106 @@
+"""Watchdog x checkpoint integration: kill mid-ladder, resume on revival.
+
+The ISSUE-4 acceptance proof, mirroring tests/test_watchdog.py's injected
+stall: a jacobi3d measurement child checkpoints every 2 steps and is
+killed (hard, os._exit) by the STENCIL_CKPT_KILL_AFTER_SAVE hook right
+after its step-2 snapshot is durable. The Revival ladder's next rung
+passes ``--resume``; the revived child must continue from step 2 (not
+step 0), finish, and leave telemetry JSONL recording resumed-from-step
+plus checkpoint write spans/bytes that apps/report.py aggregates.
+
+(Bit-exactness of the continued run is pinned in-process by
+tests/test_ckpt.py and end-to-end by scripts/ci_ckpt_gate.py — this test
+pins the supervision + revival + telemetry wiring.)
+"""
+
+import json
+import os
+import sys
+
+from stencil_tpu.obs import watchdog
+
+PY = sys.executable
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _jacobi_cmd(ckpt_dir, metrics, resume):
+    cmd = [
+        PY, "-m", "stencil_tpu.apps.jacobi3d",
+        "--cpu", "2", "--x", "16", "--y", "12", "--z", "12", "--no-weak",
+        "--iters", "4", "--ckpt-dir", ckpt_dir, "--ckpt-every", "2",
+        "--metrics-out", metrics,
+    ]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def _parse_csv(stdout):
+    for line in stdout.splitlines():
+        if line.startswith("jacobi3d,"):
+            return line
+    return None
+
+
+def test_killed_child_resumes_from_checkpoint(tmp_path):
+    ckpt_dir = str(tmp_path / "ck")
+    metrics = str(tmp_path / "metrics.jsonl")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+
+    rev = watchdog.Revival(budget_s=600, parse=_parse_csv,
+                           archive_dir=str(tmp_path / "logs"),
+                           min_attempt_s=1.0)
+    # rung 1: dies hard right after the step-2 snapshot is durable
+    env1 = dict(env)
+    env1["STENCIL_CKPT_KILL_AFTER_SAVE"] = "2"
+    p1 = rev.attempt(
+        "kill-rung", _jacobi_cmd(ckpt_dir, metrics, resume=False),
+        timeout_s=280, env=env1, cwd=REPO,
+    )
+    assert p1 is None
+    assert rev.attempts[0].outcome == watchdog.CRASH
+    assert rev.attempts[0].rc == 17
+    # the kill left a durable, valid step-2 snapshot behind — and LATEST
+    # names a COMPLETE snapshot (the pointer only ever moves after the
+    # payloads + manifest landed), never a partial one
+    from stencil_tpu.ckpt import find_resume, read_latest, validate_snapshot
+
+    latest = read_latest(ckpt_dir)
+    assert latest is not None
+    assert validate_snapshot(os.path.join(ckpt_dir, latest)) == []
+    found = find_resume(ckpt_dir)
+    assert found is not None and found[1]["step"] == 2
+
+    # rung 2: the revival passes --resume; the child must continue from
+    # step 2 to completion and produce the result row
+    p2 = rev.attempt(
+        "resume-rung", _jacobi_cmd(ckpt_dir, metrics, resume=True),
+        timeout_s=280, env=env, cwd=REPO,
+    )
+    assert p2 is not None, rev.attempts[-1].stderr_tail
+    assert rev.attempts[1].outcome == watchdog.OK
+    assert "resuming from checkpointed step 2" in (
+        rev.attempts[1].stdout + rev.attempts[1].stderr_tail
+    )
+    # final state is durable at the target step
+    found = find_resume(ckpt_dir)
+    assert found[1]["step"] == 4
+
+    # telemetry: resumed-from-step + checkpoint write spans/bytes, all
+    # schema-valid and aggregatable by apps/report.py
+    records = [json.loads(l) for l in open(metrics) if l.strip()]
+    resumed = [r for r in records if r["name"] == "ckpt.resumed_from_step"]
+    assert resumed and resumed[0]["value"] == 2
+    writes = [r for r in records if r["name"] == "ckpt.write"]
+    assert writes and all(r["seconds"] >= 0 for r in writes)
+    wbytes = [r for r in records if r["name"] == "ckpt.bytes_written"]
+    assert wbytes and all(r["bytes"] > 0 for r in wbytes)
+
+    from stencil_tpu.apps.report import aggregate, load
+
+    recs, errors = load([metrics])
+    assert not errors
+    agg = aggregate(recs)
+    assert any("ckpt" in name for name in agg["spans"])

@@ -1,0 +1,60 @@
+"""utils/sync.py (hard_sync) tests — the scalar-fetch completion barrier.
+
+hard_sync is the timing discipline every bench app rides (fetch one
+scalar, forcing completion of everything queued before it — because
+block_until_ready lies on the tunneled TPU platform). Pinned here: it
+works on bare arrays, on pytrees (first leaf in jax.tree order), and on
+0-d leaves, and returns the fetched element as a float.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stencil_tpu.utils.sync import hard_sync
+
+
+def test_scalar_fetch_returns_first_element():
+    x = jnp.arange(12.0).reshape(3, 4) + 5.0
+    assert hard_sync(x) == 5.0
+    assert isinstance(hard_sync(x), float)
+
+
+def test_forces_completion_of_queued_work():
+    # the fetched value reflects the finished computation, not the input
+    x = jnp.ones((8, 8))
+    y = jax.jit(lambda a: a * 3 + 1)(x)
+    assert hard_sync(y) == 4.0
+
+
+def test_pytree_dict_uses_first_leaf():
+    # jax.tree order for dicts is sorted keys: "a" is the first leaf
+    tree = {"b": jnp.full((2, 2), 7.0), "a": jnp.full((3,), 2.0)}
+    assert hard_sync(tree) == 2.0
+
+
+def test_nested_pytree():
+    tree = {"x": [jnp.array([[9.0, 1.0]]), jnp.zeros(4)], "y": jnp.ones(2)}
+    assert hard_sync(tree) == 9.0
+
+
+def test_zero_d_leaf():
+    # a 0-d leaf has no indexable axes: the empty index tuple must work
+    assert hard_sync(jnp.float32(3.5)) == 3.5
+    assert hard_sync({"s": jnp.array(2.25)}) == 2.25
+
+
+def test_sharded_stacked_array():
+    # the shape the apps actually sync: a sharded stacked-block array
+    from jax.sharding import NamedSharding
+
+    from stencil_tpu.parallel.mesh import BLOCK_PSPEC, grid_mesh
+    from stencil_tpu.geometry import Dim3
+
+    mesh = grid_mesh(Dim3(2, 2, 2), jax.devices()[:8])
+    arr = jax.device_put(
+        jnp.full((2, 2, 2, 4, 4, 4), 1.5, jnp.float32),
+        NamedSharding(mesh, BLOCK_PSPEC),
+    )
+    assert hard_sync(arr) == 1.5
+    assert hard_sync({"q": arr}) == 1.5
