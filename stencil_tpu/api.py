@@ -88,6 +88,7 @@ class DistributedDomain:
         self._names: List[str] = []
         self._dtypes: List[str] = []
         self._method = Method.AXIS_COMPOSED
+        self._batch_quantities = True
         self._devices: Optional[Sequence] = None
         self._partition_dim: Optional[Dim3] = None
         self._placement = None
@@ -121,6 +122,15 @@ class DistributedDomain:
     def set_methods(self, method: Method) -> None:
         """Exchange strategy (reference: stencil.hpp:139)."""
         self._method = method
+
+    def set_quantity_batching(self, enabled: bool) -> None:
+        """Quantity-batched exchange (default on): per collective, all
+        same-dtype quantities' boundary slabs ride ONE packed ``(Q, ...)``
+        carrier, so the collective count per exchange is independent of
+        the quantity count (parallel/exchange.py module docstring). Off =
+        the historical one-collective-per-quantity program — the A/B
+        baseline of ``bench_exchange --batched-ab``."""
+        self._batch_quantities = bool(enabled)
 
     def set_devices(self, devices: Sequence) -> None:
         """Restrict to specific devices (reference ``set_gpus``,
@@ -189,7 +199,10 @@ class DistributedDomain:
         t0 = time.perf_counter()
         with timer.timed("setup.realize"), timer.trace_range("stencil.realize"):
             shape = self.spec.stacked_shape_zyx()
-            self._exchange = HaloExchange(self.spec, self.mesh, self._method)
+            self._exchange = HaloExchange(
+                self.spec, self.mesh, self._method,
+                batch_quantities=self._batch_quantities,
+            )
             sharding = self._exchange.sharding()
             for idx, dt in enumerate(self._dtypes):
                 self._curr[idx] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)

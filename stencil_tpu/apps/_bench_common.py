@@ -125,13 +125,23 @@ def time_exchange(
     dtype: str = "float32",
     chunk: int = 10,
     prefix: str = "",
+    batch_quantities: bool = True,
+    partition=None,
 ) -> dict:
     """Realize a domain with ``quantities`` quantities and time ``iters``
-    exchanges in fused chunks. Returns stats + the domain."""
+    exchanges in fused chunks. Returns stats + the domain.
+
+    ``batch_quantities=False`` times the historical
+    one-collective-per-quantity program (the ``--batched-ab`` baseline);
+    ``partition`` forces the block grid (e.g. ``(2, 2, 2)``) so A/B runs
+    pin the mesh instead of trusting the auto-partitioner."""
     devices = list(devices) if devices is not None else jax.devices()
     dd = DistributedDomain(size.x, size.y, size.z)
     dd.set_radius(radius)
     dd.set_methods(method)
+    dd.set_quantity_batching(batch_quantities)
+    if partition is not None:
+        dd.set_partition(partition)
     dd.set_devices(devices)
     if placement is not None:
         dd.set_placement(placement)
@@ -150,7 +160,8 @@ def time_exchange(
     if tail:
         loops[tail] = dd.halo_exchange.make_loop(tail)
     # compile + warm every loop size OUTSIDE the timed region
-    with rec.span("exchange.warmup", phase="compile", method=method.value):
+    with rec.span("exchange.warmup", phase="compile", method=method.value,
+                  batched=batch_quantities):
         for fn in loops.values():
             state = fn(state)
         hard_sync(state)
@@ -159,8 +170,11 @@ def time_exchange(
         # compile-time truth: census the compiled single-exchange program
         # (exact on-wire volume) alongside the measured times below; the
         # census rides the result so callers (ablate) never recompile it
+        # the batched tag keeps A/B runs separable in the aggregated
+        # gauges: without it the permutes_per_quantity tripwire would
+        # average the batched leg with its per-quantity baseline
         census = telemetry.record_exchange_truth(
-            dd.halo_exchange, state, itemsizes)
+            dd.halo_exchange, state, itemsizes, batched=batch_quantities)
 
     stats = Statistics()
     done = 0
@@ -172,16 +186,16 @@ def time_exchange(
         per = (time.perf_counter() - t0) / k
         stats.insert(per)
         rec.emit("span", "exchange.iter", phase="exchange", seconds=per,
-                 iters=k, method=method.value)
+                 iters=k, method=method.value, batched=batch_quantities)
         done += k
     dd._curr = dict(state)  # the loops donated the original buffers
     if rec.enabled:
         rec.gauge("exchange.trimean_s", stats.trimean(), phase="exchange",
-                  unit="s", method=method.value)
+                  unit="s", method=method.value, batched=batch_quantities)
         rec.gauge(
             "exchange.gb_per_s",
             dd.halo_exchange.bytes_logical(itemsizes) / stats.trimean() / 1e9,
-            phase="exchange", method=method.value,
+            phase="exchange", method=method.value, batched=batch_quantities,
         )
     return {
         "domain": dd,

@@ -80,6 +80,7 @@ def run(
     ckpt_every: int = 0,
     ckpt_keep: int = 3,
     resume: bool = False,
+    batch_quantities: bool = True,
 ) -> dict:
     """Run ``iters`` iterations (plus one untimed warmup chunk) and return
     timing stats + the domain.
@@ -148,6 +149,10 @@ def run(
             radius = tight
     dd.set_radius(radius)
     dd.set_methods(method)
+    # the 8-field state is where quantity batching pays: one packed
+    # ppermute carrier per axis phase instead of 8 (default on; the A/B
+    # knob keeps the per-quantity collectives measurable)
+    dd.set_quantity_batching(batch_quantities)
     dd.set_devices(devices)
     dd.set_placement(placement_from_flags(trivial, random_))
     handles = {name: dd.add_data(name, dtype) for name in FIELDS}
@@ -379,6 +384,10 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--reductions", action="store_true", help="print field reductions")
     p.add_argument("--no-pallas", action="store_true",
                    help="force the unfused XLA substep path")
+    p.add_argument("--per-quantity-exchange", action="store_true",
+                   help="disable quantity batching: one collective per "
+                        "field per phase instead of one packed carrier for "
+                        "all 8 fields (the A/B baseline)")
     p.add_argument("--kernel-variant", choices=("shift", "ring"), default=None,
                    help="fused-substep sliding-window discipline: 'shift' "
                         "(plane-copy window shifts, the recorded kernel) or "
@@ -436,6 +445,7 @@ def main(argv: Optional[list] = None) -> int:
         ckpt_every=args.ckpt_every,
         ckpt_keep=args.ckpt_keep,
         resume=args.resume,
+        batch_quantities=not args.per_quantity_exchange,
     )
     print(csv_row(r))
     log.info(timer.report())

@@ -161,6 +161,74 @@ def ablate(x, y, z, iters=30, quantities=4, devices=None, radius=2):
     return rows, agree
 
 
+def batched_ab(x, y, z, iters=30, quantities=(1, 4, 8), devices=None,
+               radius=2, partition=None):
+    """Quantity-batching A/B: at each Q, time the batched exchange (one
+    packed ``(Q, ...)`` carrier per collective — Q-independent permute
+    count) against the historical per-quantity program on the SAME domain
+    shape, with the collective census of both compiled programs and a
+    field-for-field bit-parity check of one exchange on coordinate fields.
+
+    Returns ``(rows, q_independent, parity)``: ``q_independent`` is True
+    iff the batched permute count is identical across every Q (the
+    tentpole claim — e.g. 6 at Q=1 and Q=8 on a 2×2×2 mesh, where the
+    per-quantity column reads 6·Q); ``parity`` is True iff batched and
+    per-quantity results agree bitwise at every Q."""
+    devices = list(devices) if devices is not None else jax.devices()
+    rec = telemetry.get()
+    rows = []
+    batched_counts = {}
+    parity = True
+    for q in quantities:
+        outs = {}
+        for batched in (True, False):
+            r = time_exchange(
+                Dim3(x, y, z), Radius.constant(radius), iters,
+                devices=devices, quantities=q, batch_quantities=batched,
+                partition=partition,
+            )
+            dd = r["domain"]
+            ex = dd.halo_exchange
+            state = coord_state(dd, q)
+            census = r.pop("census", None)
+            if census is None:
+                # metrics disabled (census is non-None exactly when the
+                # recorder is on — time_exchange already recorded it,
+                # batched-tagged, in that case): compile it for the table
+                census = ex.collective_census(state)
+            cp = census.get("collective-permute", (0, 0))
+            label = "batched" if batched else "per-quantity"
+            rows.append({
+                "config": f"{x}-{y}-{z}/q={q}/{label}",
+                "bytes": r["bytes_logical"],
+                "trimean_s": r["trimean_s"],
+                "bytes_per_s": r["bytes_logical"] / r["trimean_s"],
+                "cp_count": cp[0],
+                "cp_bytes": cp[1],
+                "other_collectives": sum(
+                    c for k, (c, _b) in census.items()
+                    if k != "collective-permute"
+                ),
+            })
+            if batched:
+                batched_counts[q] = cp[0]
+            # one exchange on coordinate fields for the parity gate (the
+            # state is donated to it, so gather the result immediately)
+            out = ex(state)
+            outs[batched] = np.stack(
+                [np.asarray(jax.device_get(out[i])) for i in sorted(out)]
+            )
+        if not np.array_equal(outs[True], outs[False]):
+            parity = False
+    q_independent = len(set(batched_counts.values())) == 1
+    if rec.enabled:
+        rec.gauge("batched_ab.q_independent", int(q_independent),
+                  phase="verify")
+        rec.gauge("batched_ab.bit_for_bit_agreement", int(parity),
+                  phase="verify")
+    return rows, q_independent, parity
+
+
 def report_header() -> str:
     return "config,bytes,trimean (s),B/s"
 
@@ -197,6 +265,18 @@ def main(argv: Optional[list] = None) -> int:
                    help="run ONLY the three-method ablation, with collective "
                         "census columns and a bit-for-bit agreement gate "
                         "(exit 1 on disagreement)")
+    p.add_argument("--quantities", default="",
+                   help="quantity count for the sweeps (single int; default "
+                        "4), or a comma list of Qs for --batched-ab "
+                        "(default 1,4,8)")
+    p.add_argument("--batched-ab", action="store_true",
+                   help="run ONLY the quantity-batching A/B: batched vs "
+                        "per-quantity collectives at each Q with census "
+                        "columns; exit 1 unless the batched permute count "
+                        "is Q-independent and results agree bit-for-bit")
+    p.add_argument("--partition", default="",
+                   help="force the partition grid as XxYxZ (e.g. 2x2x2) "
+                        "for --batched-ab")
     p.add_argument("--cpu", type=int, default=0)
     add_metrics_flags(p)
     args = p.parse_args(argv)
@@ -204,8 +284,31 @@ def main(argv: Optional[list] = None) -> int:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
     start_metrics(args, "bench_exchange")
+    qs = [int(t) for t in str(args.quantities).split(",") if t.strip()]
+    if args.batched_ab:
+        partition = None
+        if args.partition:
+            partition = tuple(int(t) for t in args.partition.split("x"))
+        rows, q_indep, parity = batched_ab(
+            args.x, args.y, args.z, iters=args.iters,
+            quantities=tuple(qs) if qs else (1, 4, 8), partition=partition,
+        )
+        print(ablate_header())
+        for row in rows:
+            print(ablate_row(row))
+        print(f"# batched permute count Q-independent: "
+              f"{'PASS' if q_indep else 'FAIL'}")
+        print(f"# batched vs per-quantity bit-for-bit: "
+              f"{'PASS' if parity else 'FAIL'}")
+        return 0 if q_indep and parity else 1
+    if len(qs) > 1:
+        # a silent truncation to qs[0] would print plausible rows for a
+        # configuration the user did not ask for
+        p.error("a comma list of --quantities requires --batched-ab")
+    nq = qs[0] if qs else 4
     if args.ablate:
-        rows, agree = ablate(args.x, args.y, args.z, iters=args.iters)
+        rows, agree = ablate(args.x, args.y, args.z, iters=args.iters,
+                             quantities=nq)
         print(ablate_header())
         for row in rows:
             print(ablate_row(row))
@@ -213,10 +316,11 @@ def main(argv: Optional[list] = None) -> int:
         return 0 if agree and len(rows) == len(ABLATE_METHODS) else 1
     print(report_header())
     for row in run(args.x, args.y, args.z, iters=args.iters,
-                   method=Method(args.method)):
+                   method=Method(args.method), quantities=nq):
         print(report_row(row))
     if args.methods:
-        for row in compare_methods(args.x, args.y, args.z, iters=args.iters):
+        for row in compare_methods(args.x, args.y, args.z, iters=args.iters,
+                                   quantities=nq):
             row.pop("domain", None)
             print(report_row(row))
     return 0

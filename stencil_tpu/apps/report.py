@@ -63,10 +63,15 @@ def _agg_key(rec: dict) -> str:
     """Aggregation key: the record name, split per exchange method when a
     ``method`` tag is present — a method-ablation run intentionally emits
     different census/byte/timing values per method, and folding them under
-    one name would mix timings and false-positive the DISAGREE flag."""
-    if "method" in rec:
-        return f"{rec['name']}[{rec['method']}]"
-    return rec["name"]
+    one name would mix timings and false-positive the DISAGREE flag. The
+    ``batched`` tag splits the same way: a quantity-batching A/B run emits
+    both legs' truths (e.g. ``exchange.permutes_per_quantity`` 6/Q vs 6),
+    and averaging them would read as neither."""
+    name = rec["name"]
+    tags = [str(rec[t]) for t in ("method", "batched") if t in rec]
+    if tags:
+        return f"{name}[{','.join(tags)}]"
+    return name
 
 
 def aggregate(records: List[dict]) -> dict:

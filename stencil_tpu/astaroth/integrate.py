@@ -410,7 +410,11 @@ def make_astaroth_step(
             .py) was the 7-region x 3-substep op-graph under f64's ~10x
             emulation expansion, not fp64 itself."""
             out = _integrate_region(0, compute, inv_ds, c, dt, curr, out)
-            curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+            # exchange_blocks: the 8 same-dtype fields ride packed
+            # quantity-batched carriers (one ppermute pair per axis phase
+            # for the whole dict); reads pre-update curr only, so the
+            # overlap-as-dataflow structure is unchanged
+            curr = ex.exchange_blocks(curr)
             for rect in exteriors:
                 out = _integrate_region(0, rect, inv_ds, c, dt, curr, out)
             for s in (1, 2):
@@ -420,7 +424,7 @@ def make_astaroth_step(
         def substep_block(substep, curr, out):
             if use_overlap:
                 out = _integrate_region(substep, interior, inv_ds, c, dt, curr, out)
-                curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+                curr = ex.exchange_blocks(curr)
                 for rect in exteriors:
                     out = _integrate_region(substep, rect, inv_ds, c, dt, curr, out)
             elif use_dyn_overlap:
@@ -431,7 +435,7 @@ def make_astaroth_step(
                 out = _integrate_region(
                     substep, compute, inv_ds, c, dt, curr, out, mask=imask
                 )
-                curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+                curr = ex.exchange_blocks(curr)
                 out_read = out
                 for lo, size in shells:
                     out = _integrate_region_dyn(
@@ -439,7 +443,7 @@ def make_astaroth_step(
                         out_read=out_read,
                     )
             else:
-                curr = {k: ex.exchange_block(v) for k, v in curr.items()}
+                curr = ex.exchange_blocks(curr)
                 out = _integrate_region(substep, compute, inv_ds, c, dt, curr, out)
             return curr, out
 

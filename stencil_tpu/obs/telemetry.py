@@ -303,11 +303,34 @@ def record_exchange_truth(ex, state, itemsizes: Sequence[int],
 
     Compiles one single-exchange program; callers gate on
     :func:`enabled` so metric-less runs pay nothing.
+
+    Besides the raw census, records the packed on-wire totals and the
+    ``exchange.permutes_per_quantity`` gauge — permute ops divided by the
+    quantity count. With quantity batching this reads ~6/Q for the
+    composed plan (one packed carrier pair per axis phase, Q-independent
+    count); a reading that scales back up toward 6 (or 26) per quantity
+    at Q > 1 flags a regression to per-quantity collectives
+    (apps/report.py surfaces the gauge).
     """
     rec = rec or get()
     census = ex.collective_census(state)
     method = getattr(ex.method, "value", str(ex.method))
+    nq = max(1, len(itemsizes))
     record_census(census, rec, method=method, **tags)
+    from ..utils.hlo_check import census_per_quantity
+
+    on_wire = sum(b for _c, b in census.values())
+    rec.counter("exchange.bytes_on_wire", bytes=on_wire, phase="exchange",
+                method=method, quantities=nq, **tags)
+    per_q = census_per_quantity(census, nq)
+    rec.counter(
+        "exchange.bytes_on_wire_per_quantity",
+        bytes=sum(b for _c, b in per_q.values()),
+        phase="exchange", method=method, quantities=nq, **tags,
+    )
+    cp_count = census.get("collective-permute", (0, 0))[0]
+    rec.gauge("exchange.permutes_per_quantity", cp_count / nq,
+              phase="exchange", method=method, quantities=nq, **tags)
     rec.counter("exchange.bytes_logical", bytes=ex.bytes_logical(itemsizes),
                 phase="exchange", method=method, **tags)
     rec.counter("exchange.bytes_moved", bytes=ex.bytes_moved(itemsizes),

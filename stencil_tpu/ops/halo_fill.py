@@ -39,6 +39,45 @@ _LANE = 128
 _SUB = 8
 
 
+# -- quantity grouping / packed carriers --------------------------------------
+# Shared between the fused multi-quantity fill kernels below and the
+# quantity-batched exchange phases (parallel/exchange.py): a multi-quantity
+# state is processed per same-dtype GROUP (never bitcast), and a group's
+# boundary slabs ride one packed (Q, ...) carrier per data movement — the
+# ppermute analogue of the reference's per-neighbor multi-quantity message
+# (reference: packer.cu:10-26, the DevicePacker laying q quantities into one
+# contiguous buffer).
+
+
+def dtype_groups(state):
+    """``[(dtype, [keys])]`` of a quantity dict, grouped by dtype in
+    first-appearance order. The grouping unit for packed carriers and
+    fused fills: quantities in one group share every slab shape and may
+    be stacked without bitcasting; distinct dtypes exchange separately."""
+    groups = {}
+    for k, v in state.items():
+        groups.setdefault(jnp.dtype(v.dtype), []).append(k)
+    return list(groups.items())
+
+
+def pack_slabs(slabs):
+    """Stack a same-dtype group's boundary slabs into the packed
+    ``(Q, ...slab)`` carrier that rides one collective (packer.cu's
+    per-neighbor message re-expressed for ``lax.ppermute``).
+
+    A single-slab group degenerates to the slab itself (no leading unit
+    axis), so the batched phase bodies at Q=1 compile the exact historical
+    per-quantity program — they ARE the per-quantity implementation then."""
+    return slabs[0] if len(slabs) == 1 else jnp.stack(slabs)
+
+
+def unpack_slabs(carrier, nq: int):
+    """Scatter a packed ``(Q, ...slab)`` carrier back into per-quantity
+    slabs (static leading index — XLA fuses these into the halo updates);
+    inverse of :func:`pack_slabs`, including the Q=1 degeneration."""
+    return [carrier] if nq == 1 else [carrier[q] for q in range(nq)]
+
+
 def _axis_geom(spec: GridSpec, axis: str) -> Tuple[int, int, int]:
     """(offset, size, (rm, rp)) along one axis."""
     off = spec.compute_offset()

@@ -220,6 +220,24 @@ def collective_census(hlo_text: str) -> Dict[str, Tuple[int, int]]:
     return out
 
 
+def census_per_quantity(census: Dict[str, Tuple[int, int]],
+                        quantities: int) -> Dict[str, Tuple[int, int]]:
+    """Attribute a quantity-batched census back to logical per-quantity
+    bytes: ``{kind: (count, bytes // Q)}``.
+
+    With quantity batching (parallel/exchange.py) one collective carries a
+    packed ``(Q, ...)`` carrier of every same-dtype quantity's slab, so a
+    raw census reports Q quantities' bytes on each op. Dividing by the
+    quantity count restores the per-quantity figure the reference's
+    Allreduced per-method byte counters speak (src/stencil.cu:139-161) —
+    what one quantity's halos cost on the wire — while the COUNT column
+    stays the batched truth (the whole point: Q-independent). For an
+    unbatched program the two accountings coincide at Q = 1 and differ by
+    exactly the op-count factor otherwise."""
+    q = max(1, int(quantities))
+    return {k: (c, b // q) for k, (c, b) in census.items()}
+
+
 def assert_overlap_independent(mlir_text: str, expect_permutes: int = None) -> dict:
     """Raise AssertionError unless the permutes and the kernel are mutually
     independent (the overlap-enabling dataflow)."""

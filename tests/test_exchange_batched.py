@@ -1,0 +1,164 @@
+"""Quantity-batched halo exchange — bit parity and collective census.
+
+The tentpole claim (ISSUE 5): with ``batch_quantities`` (the default) every
+collective carries ONE packed ``(Q, ...slab)`` carrier of a same-dtype
+group's boundary slabs, so the collective count per exchange is independent
+of the quantity count — 6 composed permutes (or ≤26 direct ones) total, not
+per quantity — while the result stays bit-identical to the per-quantity
+program (the exchange is pure data movement). Parity is pinned for
+fp32/fp64/mixed dicts on uniform, remainder, and oversubscribed partitions;
+the census pin (batched Q=8 emits the Q=1 permute count) is what the CI
+gate (`bench_exchange --batched-ab`) re-checks on every push.
+
+Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks
+
+FP32 = ("float32",) * 3
+FP64 = ("float64",) * 3
+MIXED = ("float32", "float64", "float32", "float64")
+
+
+def _coord(g: Dim3) -> np.ndarray:
+    return (
+        np.arange(g.z)[:, None, None] * 1_000_000.0
+        + np.arange(g.y)[None, :, None] * 1_000.0
+        + np.arange(g.x)[None, None, :]
+    )
+
+
+def _state(spec, mesh, dtypes):
+    c = _coord(spec.global_size)
+    return {
+        i: shard_blocks((c + i).astype(dt), spec, mesh)
+        for i, dt in enumerate(dtypes)
+    }
+
+
+def _ab_outputs(spec, mesh, dtypes, method=Method.AXIS_COMPOSED):
+    """One exchange through the batched and the per-quantity program (fresh
+    states each — the exchange donates its buffers); host-side results."""
+    outs = {}
+    for batched in (True, False):
+        ex = HaloExchange(spec, mesh, method, batch_quantities=batched)
+        out = ex(_state(spec, mesh, dtypes))
+        outs[batched] = {
+            k: np.asarray(jax.device_get(v)) for k, v in out.items()
+        }
+    return outs
+
+
+def _assert_parity(outs, dtypes):
+    for k in range(len(dtypes)):
+        assert outs[True][k].dtype == outs[False][k].dtype == np.dtype(dtypes[k])
+        np.testing.assert_array_equal(outs[True][k], outs[False][k])
+
+
+@pytest.mark.parametrize("dtypes", [FP32, FP64, MIXED],
+                         ids=["fp32", "fp64", "mixed"])
+def test_batched_parity_uniform(dtypes):
+    spec = GridSpec(Dim3(8, 8, 8), Dim3(2, 2, 2), Radius.constant(2))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    _assert_parity(_ab_outputs(spec, mesh, dtypes), dtypes)
+
+
+@pytest.mark.parametrize("dtypes", [FP32, FP64, MIXED],
+                         ids=["fp32", "fp64", "mixed"])
+def test_batched_parity_remainder(dtypes):
+    """Uneven split on every axis: the packed carrier's slab starts are
+    traced size-table lookups, exactly like the per-quantity phases."""
+    spec = GridSpec(Dim3(11, 9, 13), Dim3(2, 2, 2), Radius.constant(2))
+    assert not spec.is_uniform()
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    _assert_parity(_ab_outputs(spec, mesh, dtypes), dtypes)
+
+
+def test_batched_parity_oversubscribed_uneven():
+    """Resident z-stacking with an uneven resident axis (z = 7+6 on 4
+    devices, mixed dtypes): only the boundary slabs ride the (packed)
+    permute; the resident-neighbor shifts stay per-quantity local copies."""
+    spec = GridSpec(Dim3(12, 12, 13), Dim3(2, 2, 2), Radius.constant(2))
+    mesh = grid_mesh(Dim3(2, 2, 1), jax.devices()[:4])
+    _assert_parity(_ab_outputs(spec, mesh, MIXED), MIXED)
+
+
+def test_batched_parity_direct26():
+    """DIRECT26 batching: one packed carrier per active direction (uniform
+    and remainder partitions, incl. the face→edge→corner layering of the
+    uneven path)."""
+    for size in (Dim3(8, 8, 8), Dim3(11, 9, 13)):
+        spec = GridSpec(size, Dim3(2, 2, 2), Radius.constant(2))
+        mesh = grid_mesh(spec.dim, jax.devices()[:8])
+        _assert_parity(_ab_outputs(spec, mesh, MIXED, Method.DIRECT26), MIXED)
+
+
+def test_batched_census_q_independent():
+    """The tentpole pin: batched AXIS_COMPOSED at Q=8 emits the SAME
+    ppermute count as Q=1 (6 on the 2x2x2 mesh) with Q× the carrier
+    bytes; the per-quantity program emits 6·Q. census_per_quantity
+    attributes the packed bytes back to the logical per-quantity figure."""
+    spec = GridSpec(Dim3(8, 8, 8), Dim3(2, 2, 2), Radius.constant(2))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+
+    def census(ex, q, dtypes=None):
+        dtypes = dtypes or ("float32",) * q
+        return ex.collective_census(_state(spec, mesh, dtypes))
+
+    exb = HaloExchange(spec, mesh)
+    assert exb.batch_quantities  # default on
+    c1 = census(exb, 1)
+    c8 = census(exb, 8)
+    assert c1["collective-permute"][0] == c8["collective-permute"][0] == 6
+    assert c8["collective-permute"][1] == 8 * c1["collective-permute"][1]
+
+    exp = HaloExchange(spec, mesh, batch_quantities=False)
+    assert census(exp, 8)["collective-permute"][0] == 6 * 8
+
+    from stencil_tpu.utils.hlo_check import census_per_quantity
+
+    per_q = census_per_quantity(c8, 8)
+    assert per_q["collective-permute"] == c1["collective-permute"]
+
+    # mixed dtypes never share a carrier (no bitcast): one packed pair per
+    # phase per dtype group -> 12 permutes for a 2-group dict at any Q
+    cm = census(exb, 4, MIXED)
+    assert cm["collective-permute"][0] == 12
+
+
+def test_batched_census_direct26_q_independent():
+    spec = GridSpec(Dim3(8, 8, 8), Dim3(2, 2, 2), Radius.constant(2))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    exd = HaloExchange(spec, mesh, Method.DIRECT26)
+
+    def census(q):
+        return exd.collective_census(_state(spec, mesh, ("float32",) * q))
+
+    c1, c4 = census(1), census(4)
+    assert c1["collective-permute"][0] == c4["collective-permute"][0] == 26
+    assert c4["collective-permute"][1] == 4 * c1["collective-permute"][1]
+
+
+def test_domain_quantity_batching_knob():
+    """api.py wiring: set_quantity_batching reaches the realized
+    HaloExchange; default is on."""
+    from stencil_tpu.api import DistributedDomain
+
+    for enabled in (True, False):
+        dd = DistributedDomain(8, 8, 8)
+        dd.set_radius(1)
+        dd.set_partition((2, 2, 2))
+        dd.set_devices(jax.devices()[:8])
+        if not enabled:
+            dd.set_quantity_batching(False)
+        dd.add_data("a")
+        dd.add_data("b", "float64")
+        dd.realize()
+        assert dd.halo_exchange.batch_quantities is enabled

@@ -77,8 +77,11 @@ def test_jacobi_sidebuf_overlap_dataflow():
 
 def test_astaroth_pallas_overlap_dataflow():
     rep = _report("astaroth-overlap")
-    # 6 permutes (2 per axis phase) x 8 quantities
-    assert rep["n_permutes"] == 48
+    # 6 permutes (2 per axis phase) TOTAL: the 8 fields' slabs ride packed
+    # quantity-batched carriers (was 6 x 8 before ISSUE 5), and the packed
+    # permutes still consume only pre-exchange data — the overlap
+    # structure survives batching
+    assert rep["n_permutes"] == 6
     # 3 substep kernels; substep 0 (pre-exchange input) is the free one
     assert rep["n_kernels"] == 3
     assert not rep["permutes_consume_kernel"]

@@ -109,9 +109,17 @@ def test_exchange_truth_lands_in_metrics_file(tmp_path):
             "exchange.bytes_moved", "exchange.trimean_s",
             "exchange.gb_per_s"} <= names
     cp = next(r for r in records if r["name"] == "census.collective-permute")
-    # composed method: 6 hand-written permutes per quantity, 2 quantities
-    assert cp["value"] == 6 * 2
+    # composed method with quantity batching (the default): 6 packed
+    # carriers total, independent of the 2 quantities
+    assert cp["value"] == 6
     assert cp["bytes"] > 0
+    ppq = next(r for r in records
+               if r["name"] == "exchange.permutes_per_quantity")
+    assert ppq["value"] == 6 / 2 and ppq["quantities"] == 2
+    wire = next(r for r in records if r["name"] == "exchange.bytes_on_wire")
+    wire_q = next(r for r in records
+                  if r["name"] == "exchange.bytes_on_wire_per_quantity")
+    assert wire["bytes"] == 2 * wire_q["bytes"] > 0
     bl = next(r for r in records if r["name"] == "exchange.bytes_logical")
     assert bl["bytes"] > 0
 
