@@ -162,9 +162,10 @@ def _child_main(mode: str, resume: bool = False) -> int:
     import numpy as np
 
     def _exchange_leg(method, nq: int = 4, ndev: int = 1, nb: int = None,
-                      batched: bool = True) -> float:
+                      batched: bool = True, dim: Dim3 = None) -> float:
         nb = nb if nb is not None else n
-        dim = Dim3(2, 2, 2) if ndev == 8 else Dim3(1, 1, 1)
+        if dim is None:
+            dim = Dim3(2, 2, 2) if ndev == 8 else Dim3(1, 1, 1)
         spec = GridSpec(Dim3(nb, nb, nb), dim, Radius.constant(3))
         mesh = grid_mesh(spec.dim, jax.devices()[:ndev])
         ex = HaloExchange(spec, mesh, method, batch_quantities=batched)
@@ -212,6 +213,42 @@ def _child_main(mode: str, resume: bool = False) -> int:
             ex_pq_gb_s = _exchange_leg(Method.AXIS_COMPOSED, batched=False, **ab)
         except Exception as e:
             errors["exchange_batched"] = f"{type(e).__name__}: {e}"[:400]
+
+    # exchange-plan autotuner leg (ROADMAP #3): tune (partition x method x
+    # batching) for a radius-3 4-quantity config, then time the tuned plan
+    # against the plan-less default (NodePartition + AXIS_COMPOSED +
+    # batching) at the SAME size — the tracked plan_autotuned_over_default
+    # ratio (> 1 means the autotuner beat the default). The tuner runs
+    # in-memory here (no DB): the leg measures tuning quality, not cache
+    # behavior (scripts/ci_plan_gate.py pins the zero-probe replay).
+    plan_tuned_gb_s = 0.0
+    plan_default_gb_s = 0.0
+    plan_label = None
+    if leg("exchange plan autotune"):
+        try:
+            from stencil_tpu.plan.autotune import autotune, default_choice
+
+            nbp = min(n, 128) if on_accel else 64
+            ndevp = 8 if len(jax.devices()) >= 8 else 1
+            res = autotune(
+                Dim3(nbp, nbp, nbp), Radius.constant(3), ["float32"] * 4,
+                devices=jax.devices()[:ndevp], top_n=2, probe_iters=3,
+            )
+            ch = res.choice
+            plan_label = ch.label()
+            from stencil_tpu.parallel import Method as _M
+
+            plan_tuned_gb_s = _exchange_leg(
+                _M(ch.method), nq=4, ndev=ndevp, nb=nbp,
+                batched=ch.batch_quantities, dim=Dim3.of(ch.partition),
+            )
+            dflt = default_choice(res.config)
+            plan_default_gb_s = _exchange_leg(
+                _M(dflt.method), nq=4, ndev=ndevp, nb=nbp,
+                batched=dflt.batch_quantities, dim=Dim3.of(dflt.partition),
+            )
+        except Exception as e:
+            errors["plan_autotune"] = f"{type(e).__name__}: {e}"[:400]
 
     # astaroth flagship details (BASELINE configs 4/4b): 8 fp32 fields,
     # fused Pallas RK3 substeps; skipped off-accelerator, via
@@ -289,6 +326,15 @@ def _child_main(mode: str, resume: bool = False) -> int:
         "exchange_batchedq_over_perq": (
             round(ex_bq_gb_s / ex_pq_gb_s, 3) if ex_pq_gb_s else 0.0
         ),
+        # exchange-plan autotuner leg: tuned plan's bandwidth over the
+        # plan-less default at the same config (> 1: the tuner won)
+        "plan_autotuned_gb_per_s": round(plan_tuned_gb_s, 2),
+        "plan_default_gb_per_s": round(plan_default_gb_s, 2),
+        "plan_autotuned_over_default": (
+            round(plan_tuned_gb_s / plan_default_gb_s, 3)
+            if plan_default_gb_s else 0.0
+        ),
+        "plan_choice": plan_label,
         "astaroth_256_iter_ms": asta_ms,
         "astaroth_512_iter_ms": asta512_ms,
         "jacobi3d_768_mcells_per_s": jac768,

@@ -50,39 +50,16 @@ from .utils import timer
 from .utils.sync import hard_sync
 
 
-def _stack_residents(dim: Dim3, c: int) -> Dim3:
-    """Mesh dims for stacking ``c`` resident blocks per device onto
-    partition ``dim``: the z-heaviest (cz, cy, cx) factorization of ``c``
-    whose components divide the partition axes (exhaustive — divisor
-    triples of c are few). Reference envelope: dd.set_gpus accepts any
-    block multiset per device (stencil.hpp:154)."""
-    best = None
-    for cz in range(c, 0, -1):
-        if c % cz or dim.z % cz:
-            continue
-        cyx = c // cz
-        for cy in range(cyx, 0, -1):
-            if cyx % cy or dim.y % cy:
-                continue
-            cx = cyx // cy
-            if dim.x % cx:
-                continue
-            best = Dim3(dim.x // cx, dim.y // cy, dim.z // cz)
-            break
-        if best is not None:
-            break
-    if best is None:
-        raise ValueError(
-            f"cannot stack {c} resident blocks per device onto partition "
-            f"{dim}: no divisor triple of {c} divides the axes"
-        )
-    return best
+# moved to geometry/partition.py so the plan cost model predicts the same
+# mesh realize() would build; kept as an alias for callers/tests
+from .geometry import stack_residents as _stack_residents
 
 
 class DistributedDomain:
     """A multi-quantity 3D domain distributed over a TPU device mesh."""
 
-    def __init__(self, x: int, y: int, z: int):
+    def __init__(self, x: int, y: int, z: int, plan=None,
+                 autotune: bool = False, plan_db: Optional[str] = None):
         self.size = Dim3(x, y, z)
         self.radius = Radius.constant(0)
         self._names: List[str] = []
@@ -92,6 +69,18 @@ class DistributedDomain:
         self._devices: Optional[Sequence] = None
         self._partition_dim: Optional[Dim3] = None
         self._placement = None
+        # exchange planning (stencil_tpu/plan/): an explicit tuned choice,
+        # or realize()-time autotuning against the on-disk plan DB
+        self._plan_choice = None
+        self._autotune_opts: Optional[dict] = None
+        self.autotune_result = None
+        if plan is not None:
+            self.set_plan(plan)
+            if autotune:
+                log.warn("explicit plan= suppresses autotune=: the given "
+                         "choice is applied as-is (drop plan= to re-tune)")
+        if autotune:
+            self.enable_autotune(db_path=plan_db)
         self._output_prefix = os.environ.get("STENCIL_OUTPUT_PREFIX", "")
         self._realized = False
         # data (after realize): handle.idx -> stacked array
@@ -122,6 +111,40 @@ class DistributedDomain:
     def set_methods(self, method: Method) -> None:
         """Exchange strategy (reference: stencil.hpp:139)."""
         self._method = method
+
+    def set_plan(self, choice) -> None:
+        """Apply a tuned exchange plan (a ``plan.ir.PlanChoice`` or its
+        JSON dict): partition shape, exchange method, and quantity
+        batching are applied at realize(); the choice's ``multistep_k``
+        and ``kernel_variant`` ride along for the apps that own those
+        knobs (:attr:`plan_choice`). An explicit :meth:`set_partition`
+        still wins over the plan's partition (with a warning)."""
+        from .plan.ir import PlanChoice
+
+        if isinstance(choice, dict):
+            choice = PlanChoice.from_json(choice)
+        self._plan_choice = choice
+
+    def enable_autotune(self, db_path: Optional[str] = None,
+                        probe: bool = True, top_n: int = 3,
+                        probe_iters: int = 4, ks: Sequence[int] = (1,),
+                        force: bool = False) -> None:
+        """Autotune the exchange plan at realize() time (plan/autotune):
+        consult the plan DB first (a hit replays with zero probes), else
+        rank the (partition x method x batching x k) space statically and
+        refine the top ``top_n`` with measured probes, persisting the
+        winner to ``db_path``. The result lands in
+        :attr:`autotune_result`; telemetry gets the ``plan.cache_hit``
+        gauge + ``plan.probes_run`` counter either way."""
+        self._autotune_opts = dict(
+            db_path=db_path, probe=probe, top_n=top_n,
+            probe_iters=probe_iters, ks=tuple(ks), force=force,
+        )
+
+    @property
+    def plan_choice(self):
+        """The effective tuned choice (None on a plan-less domain)."""
+        return self._plan_choice
 
     def set_quantity_batching(self, enabled: bool) -> None:
         """Quantity-batched exchange (default on): per collective, all
@@ -158,6 +181,40 @@ class DistributedDomain:
                 list(self._devices) if self._devices is not None else jax.devices()
             )
             n = len(devices)
+            if self._autotune_opts is not None and self._plan_choice is None:
+                if not self._dtypes:
+                    log.warn("autotune: no quantities declared; skipping")
+                else:
+                    from .plan.autotune import autotune as _plan_autotune
+
+                    opts = self._autotune_opts
+                    self.autotune_result = _plan_autotune(
+                        self.size, self.radius, self._dtypes,
+                        devices=devices, db_path=opts["db_path"],
+                        probe=opts["probe"], top_n=opts["top_n"],
+                        probe_iters=opts["probe_iters"], ks=opts["ks"],
+                        force=opts["force"],
+                    )
+                    self._plan_choice = self.autotune_result.choice
+            if self._plan_choice is not None:
+                ch = self._plan_choice
+                if (self._partition_dim is not None
+                        and self._partition_dim != Dim3.of(ch.partition)):
+                    # the choice was tuned as a UNIT (its method/batching
+                    # were measured on its partition); an explicit
+                    # partition overrides the whole plan, not pieces of it
+                    log.warn(
+                        f"explicit partition {self._partition_dim} overrides "
+                        f"the tuned plan {ch.label()}; the plan's method/"
+                        "batching are NOT applied (re-tune with the pinned "
+                        "partition instead)"
+                    )
+                    self._plan_choice = None
+                else:
+                    self._method = Method(ch.method)
+                    self._batch_quantities = ch.batch_quantities
+                    if self._partition_dim is None:
+                        self._partition_dim = Dim3.of(ch.partition)
             if self._partition_dim is not None:
                 dim = self._partition_dim
             else:
@@ -340,6 +397,51 @@ class DistributedDomain:
         itemsizes = [jnp.dtype(dt).itemsize for dt in self._dtypes]
         return self._exchange.bytes_moved(itemsizes)
 
+    def plan_meta(self) -> dict:
+        """The EFFECTIVE exchange plan of this realized domain — what the
+        ckpt manifests record so ``--resume`` can warn when a snapshot
+        tuned under one plan is revived under another (the state restores
+        bit-identically either way — elasticity — but the compiled
+        programs, and any recorded performance, differ)."""
+        from .plan.ir import PlanChoice, PlanConfig
+
+        assert self._realized, "plan_meta requires realize()"
+        devs = self.mesh.devices.flatten()
+        cfg = PlanConfig.make(self.size, self.radius, self._dtypes,
+                              len(devs), devs[0].platform)
+        ch = self._plan_choice
+        choice = PlanChoice(
+            partition=(self.spec.dim.x, self.spec.dim.y, self.spec.dim.z),
+            method=self._method.value,
+            batch_quantities=self._batch_quantities,
+            multistep_k=ch.multistep_k if ch is not None else 1,
+            kernel_variant=ch.kernel_variant if ch is not None else None,
+        )
+        return {"key": cfg.to_json(), "choice": choice.to_json(),
+                "tuned": ch is not None}
+
+    def _warn_plan_mismatch(self, manifest: dict) -> None:
+        saved = (manifest.get("meta") or {}).get("plan")
+        if not saved:
+            return  # pre-plan snapshot: nothing to compare
+        here = self.plan_meta()
+        saved_ch = dict(saved.get("choice") or {})
+        here_ch = dict(here["choice"])
+        if not (saved.get("tuned") or here["tuned"]):
+            # neither side went through the tuner: a partition-only delta
+            # is the supported elastic mesh-reshape resume (PR 4) and must
+            # stay quiet; method/batching deltas still mix programs
+            saved_ch.pop("partition", None)
+            here_ch.pop("partition", None)
+        if saved_ch != here_ch:
+            log.warn(
+                "ckpt: snapshot was written under exchange plan "
+                f"{saved['choice']} but this run uses {here['choice']} — "
+                "the elastic restore is still bit-exact, but the compiled "
+                "programs differ; re-tune (--autotune) or pass the "
+                "snapshot's plan to keep measurements comparable"
+            )
+
     # -- checkpoint / restart (ckpt/ subsystem) ------------------------------
     def save_checkpoint(self, ckpt_dir: str, step: int, *, keep: int = 3,
                         asynchronous: bool = True) -> None:
@@ -362,11 +464,13 @@ class DistributedDomain:
             return
         arrays = {name: self._curr[i] for i, name in enumerate(self._names)}
         dtypes = dict(zip(self._names, self._dtypes))
+        extra_meta = {"plan": self.plan_meta()}
         if not asynchronous:
             with timer.timed("ckpt.save"), timer.trace_range("ckpt.save"):
                 write_snapshot(ckpt_dir, step, self.spec,
                                host_snapshot(self.spec, arrays),
-                               dtypes=dtypes, keep=keep)
+                               dtypes=dtypes, keep=keep,
+                               extra_meta=extra_meta)
             return
         cp = getattr(self, "_checkpointer", None)
         if cp is None or cp.ckpt_dir != ckpt_dir:
@@ -376,7 +480,7 @@ class DistributedDomain:
                 ckpt_dir, keep=keep, dtypes=dtypes
             )
         cp.keep = keep
-        cp.save(self.spec, arrays, step)
+        cp.save(self.spec, arrays, step, extra_meta=extra_meta)
 
     def finish_checkpoints(self) -> None:
         """Drain the async checkpoint writer (every handed-off snapshot is
@@ -413,6 +517,9 @@ class DistributedDomain:
             log.info(f"ckpt: no valid compatible snapshot under {ckpt_dir}")
             return None
         snap, manifest = found
+        # plan provenance: resuming under a different tuned plan is legal
+        # (elastic restore) but must never be silent
+        self._warn_plan_mismatch(manifest)
         rec = telemetry.get()
         with rec.span("ckpt.restore", phase="ckpt", step=manifest["step"]):
             nbytes = 0

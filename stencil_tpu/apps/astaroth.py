@@ -81,6 +81,8 @@ def run(
     ckpt_keep: int = 3,
     resume: bool = False,
     batch_quantities: bool = True,
+    autotune: bool = False,
+    plan_db: Optional[str] = None,
 ) -> dict:
     """Run ``iters`` iterations (plus one untimed warmup chunk) and return
     timing stats + the domain.
@@ -155,8 +157,15 @@ def run(
     dd.set_quantity_batching(batch_quantities)
     dd.set_devices(devices)
     dd.set_placement(placement_from_flags(trivial, random_))
+    if autotune:
+        # plan/ subsystem: the 8-field exchange is where plan choice pays
+        # (batched vs per-quantity, partition shape); DB hits replay with
+        # zero probes, misses probe the statically-ranked top candidates
+        dd.enable_autotune(db_path=plan_db)
     handles = {name: dd.add_data(name, dtype) for name in FIELDS}
     dd.realize()
+    if autotune:
+        method = dd._method  # the tuned method labels the CSV row
 
     # init (reference: astaroth.cu:493-520): hash-random everything,
     # constant 0.5 lnrho, radial-explosion velocity
@@ -407,6 +416,12 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume from the newest valid snapshot under "
                         "--ckpt-dir when one exists (fresh start otherwise)")
+    p.add_argument("--autotune", action="store_true",
+                   help="choose the exchange plan (partition x method x "
+                        "quantity batching) via the plan/ autotuner; a plan-"
+                        "DB hit replays with zero probes")
+    p.add_argument("--plan-db", type=str, default="",
+                   help="on-disk plan DB (JSON) for --autotune")
     p.add_argument("--cpu", type=int, default=0)
     from ._bench_common import add_metrics_flags, start_metrics
     add_metrics_flags(p, dma=True)
@@ -446,6 +461,8 @@ def main(argv: Optional[list] = None) -> int:
         ckpt_keep=args.ckpt_keep,
         resume=args.resume,
         batch_quantities=not args.per_quantity_exchange,
+        autotune=args.autotune,
+        plan_db=args.plan_db or None,
     )
     print(csv_row(r))
     log.info(timer.report())

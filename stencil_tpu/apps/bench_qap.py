@@ -76,14 +76,29 @@ def run(sizes=(4, 6, 8), catch_sizes=(16, 32, 64), timeout_s=2.0):
 
 
 def main(argv: Optional[list] = None) -> int:
+    from ..obs import telemetry
+    from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
+
     p = argparse.ArgumentParser(description="QAP solver benchmark")
     p.add_argument("--sizes", type=int, nargs="+", default=[4, 6, 8])
     p.add_argument("--catch-sizes", type=int, nargs="+", default=[16, 32, 64])
     p.add_argument("--timeout", type=float, default=2.0)
+    add_metrics_flags(p)
     args = p.parse_args(argv)
+    rec = start_metrics(args, "bench_qap")
     print("solver,kind,n,cost,s")
     for row in run(tuple(args.sizes), tuple(args.catch_sizes), args.timeout):
         print(f"{row['solver']},{row['kind']},{row['n']},{row['cost']:.4f},{row['s']:.4f}")
+        if rec.enabled:
+            # per-row solver wall-clock + achieved cost, tagged like the
+            # other bench apps so apps/report.py aggregates per solver
+            # ('matrix' tag, not 'kind': that word is the record-kind
+            # field of the telemetry schema itself)
+            rec.gauge("qap.solve_s", row["s"], phase="solve", unit="s",
+                      solver=row["solver"], matrix=row["kind"], n=row["n"])
+            rec.gauge("qap.cost", row["cost"], phase="solve",
+                      solver=row["solver"], matrix=row["kind"], n=row["n"])
+    finish_metrics(rec)
     return 0
 
 

@@ -71,6 +71,8 @@ def run(
     ckpt_every: int = 0,
     ckpt_keep: int = 3,
     resume: bool = False,
+    autotune: bool = False,
+    plan_db: Optional[str] = None,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -103,6 +105,8 @@ def run(
             and size.x % 128 == 0
             and size.y % pdim.y == 0 and size.z % pdim.z == 0
             and method != Method.AUTO_SPMD  # no in-kernel x wrap globally
+            and not autotune  # the tuner may pick AUTO_SPMD, which cannot
+                              # run the tight-x no-x-halo layout
             and all(d.platform == "tpu" for d in devices)):
         # tight-x layout: a single-BLOCK x axis wraps x in-kernel (lane
         # rolls), so no x halo columns are allocated — every slab DMA
@@ -120,8 +124,15 @@ def run(
     dd.set_devices(devices)
     if partition is not None:
         dd.set_partition(partition)
+    if autotune:
+        # plan/ subsystem: choose (partition x method x batching) from the
+        # DB or by static-rank + measured probes; an explicit --partition
+        # or tight-x radius pin above still wins (realize() warns)
+        dd.enable_autotune(db_path=plan_db)
     h = dd.add_data("temperature", "float32")
     dd.realize()
+    if autotune:
+        method = dd._method  # the tuned method labels the CSV row
 
     # init: uniform lukewarm field (reference: bin/jacobi3d.cu:18-27)
     rec = telemetry.get()
@@ -372,6 +383,14 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume from the newest valid snapshot under "
                         "--ckpt-dir when one exists (fresh start otherwise)")
+    p.add_argument("--autotune", action="store_true",
+                   help="choose the exchange plan (partition x method x "
+                        "quantity batching) via the plan/ autotuner: plan-DB "
+                        "hit replays with zero probes, miss static-ranks + "
+                        "probes and persists the winner to --plan-db")
+    p.add_argument("--plan-db", type=str, default="",
+                   help="on-disk plan DB (JSON) for --autotune; also "
+                        "inspectable via apps/plan_tool.py")
     p.add_argument("--prefix", type=str, default="")
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
     p.add_argument("--deep-halo", type=int, default=1,
@@ -421,6 +440,8 @@ def main(argv: Optional[list] = None) -> int:
         ckpt_every=args.ckpt_every,
         ckpt_keep=args.ckpt_keep,
         resume=args.resume,
+        autotune=args.autotune,
+        plan_db=args.plan_db or None,
     )
     print(csv_row(r))
     log.info(f"mcells/s = {r['mcells_per_s']:.1f} ({r['mcells_per_s_per_dev']:.1f}/device)")

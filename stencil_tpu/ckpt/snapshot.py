@@ -350,11 +350,12 @@ class AsyncCheckpointer:
                     self._work.wait()
                 if self._pending is None and self._stop:
                     return
-                spec, host_state, step = self._pending
+                spec, host_state, step, extra_meta = self._pending
             try:
                 write_snapshot(
                     self.ckpt_dir, step, spec, host_state,
                     dtypes=self.dtypes, keep=self.keep,
+                    extra_meta=extra_meta,
                 )
                 err = None
             except BaseException as e:  # surfaced on the next save/flush
@@ -373,8 +374,11 @@ class AsyncCheckpointer:
             err, self._error = self._error, None
             raise err
 
-    def save(self, spec, arrays: Dict[str, "object"], step: int) -> None:
-        """Snapshot ``arrays`` (name -> stacked device array) at ``step``."""
+    def save(self, spec, arrays: Dict[str, "object"], step: int,
+             extra_meta: Optional[dict] = None) -> None:
+        """Snapshot ``arrays`` (name -> stacked device array) at ``step``.
+        ``extra_meta`` lands under the manifest's ``meta`` key (e.g. the
+        exchange-plan provenance resume checks)."""
         from ..obs import telemetry
 
         with telemetry.get().span("ckpt.save", phase="ckpt", step=int(step)):
@@ -383,7 +387,7 @@ class AsyncCheckpointer:
                 while self._pending is not None:
                     self._idle.wait()
                 self._raise_pending_error()
-                self._pending = (spec, host_state, step)
+                self._pending = (spec, host_state, step, extra_meta)
                 self._work.notify()
 
     def flush(self) -> None:

@@ -1,6 +1,6 @@
 from .dim3 import CORNER_DIRS, DIRECTIONS_26, Dim3, EDGE_DIRS, FACE_DIRS
 from .numeric import div_ceil, max_abs_error, next_power_of_two, prime_factors
-from .partition import NodePartition, RankPartition, decompose_zy
+from .partition import NodePartition, RankPartition, decompose_zy, stack_residents
 from .radius import Radius
 from .rect3 import Rect3
 from .region import (
@@ -22,6 +22,7 @@ __all__ = [
     "NodePartition",
     "decompose_zy",
     "RankPartition",
+    "stack_residents",
     "Radius",
     "Rect3",
     "compute_offset",

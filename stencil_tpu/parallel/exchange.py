@@ -52,6 +52,15 @@ the ``ppermute`` analogue of the reference's multi-quantity per-neighbor
 message (packer.cu:10-26) and the answer to the per-collective-overhead
 economics the Round-7 ablation measured (DIRECT26 moved 1.9× fewer bytes
 but ran 4.2× slower purely on collective count, BASELINE.md).
+
+Every strategy lowers from the declarative ExchangePlan IR
+(``stencil_tpu/plan/ir.py``): :attr:`HaloExchange.plan` holds the phase
+list (axis phases with permute pairs and size tables; direct26 direction
+messages with carrier extents), and the lowering bodies below consume
+phase records instead of recomputing the geometry inline. The partition/
+method autotuner (``stencil_tpu/plan/``) searches those plans — not code
+paths — and this module is required to compile each plan bit-identically
+to the historical method branches (census pins in tests/test_plan_ir.py).
 """
 
 from __future__ import annotations
@@ -68,18 +77,9 @@ from jax.sharding import Mesh, NamedSharding
 
 from ..domain.grid import GridSpec
 from ..geometry import DIRECTIONS_26, Dim3, halo_extent
+from ..plan.ir import build_plan, spec_axis as _spec_axis
 from ..utils import timer
 from .mesh import AXIS_X, AXIS_Y, AXIS_Z, BLOCK_PSPEC, block_sharding, mesh_dim
-
-# (axis name, stacked-array data dim, Dim3 accessor) in exchange-phase order.
-_AXES = (
-    (AXIS_X, 5, "x"),
-    (AXIS_Y, 4, "y"),
-    (AXIS_Z, 3, "z"),
-)
-
-# Stacked-array block dim of each axis (bz, by, bx are dims 0, 1, 2).
-_BDIM = {AXIS_Z: 0, AXIS_Y: 1, AXIS_X: 2}
 
 
 class Method(enum.Enum):
@@ -88,19 +88,6 @@ class Method(enum.Enum):
     AXIS_COMPOSED = "axis-composed"
     DIRECT26 = "direct26"
     AUTO_SPMD = "auto-spmd"
-
-
-def _spec_axis(spec: GridSpec, name: str):
-    """(per-index sizes, low radius, high radius, compute offset) along one
-    axis. The offset can exceed the low radius in aligned layouts (the y
-    compute origin is rounded to the 8-row tile); the halo always sits
-    immediately adjacent to the compute region, at [offset - rm, offset)."""
-    off = spec.compute_offset()
-    if name == AXIS_X:
-        return spec.sizes_x, spec.radius.x(-1), spec.radius.x(1), off.x
-    if name == AXIS_Y:
-        return spec.sizes_y, spec.radius.y(-1), spec.radius.y(1), off.y
-    return spec.sizes_z, spec.radius.z(-1), spec.radius.z(1), off.z
 
 
 def direction_bytes(spec: GridSpec, direction, itemsize: int) -> int:
@@ -177,6 +164,16 @@ class HaloExchange:
     def oversubscribed(self) -> bool:
         """More partition blocks than devices on at least one axis."""
         return self.resident != Dim3(1, 1, 1)
+
+    @cached_property
+    def plan(self):
+        """The declarative ExchangePlan this exchange lowers from
+        (phases, directions, pack groups, permute pairs — plan/ir.py).
+        The autotuner scores these same plans without compiling them."""
+        return build_plan(
+            self.spec, mesh_dim(self.mesh), self.method,
+            batch_quantities=self.batch_quantities, resident=self.resident,
+        )
 
     # -- public API ----------------------------------------------------------
     def __call__(self, state):
@@ -278,12 +275,12 @@ class HaloExchange:
         fshape = self._fill_shape()
         gmax = max_fill_group(self.spec) if fills else 0
         out = dict(state)
-        for name, adim, _ in _AXES:
-            sizes, rm, rp, _off = _spec_axis(self.spec, name)
-            if rm == 0 and rp == 0:
+        for phase in self.plan.axis_phases:
+            if not phase.active:
                 continue
+            name = phase.axis
             for dt, keys in groups:
-                if len(sizes) == 1 and name in fills and dt == jnp.float32:
+                if phase.blocks == 1 and name in fills and dt == jnp.float32:
                     # only the x kernel's scratch scales with the quantity
                     # count; y/z fills carry every quantity in one kernel
                     ax_gmax = gmax if name == AXIS_X else len(keys)
@@ -296,13 +293,13 @@ class HaloExchange:
                             out[k] = v.reshape(state[k].shape)
                 elif self.batch_quantities and len(keys) > 1:
                     blocks = self._axis_phase_batched(
-                        [out[k] for k in keys], name, adim
+                        [out[k] for k in keys], phase
                     )
                     for k, b in zip(keys, blocks):
                         out[k] = b
                 else:
                     for k in keys:
-                        out[k] = self._axis_phase(out[k], name, adim)
+                        out[k] = self._axis_phase(out[k], phase)
         return out
 
     def _multi_fill(self, axis: str, nq: int):
@@ -436,10 +433,10 @@ class HaloExchange:
 
     # -- axis-composed implementation ---------------------------------------
     def _composed_blocks(self, block, axes=None):
-        for name, adim, _ in _AXES:
-            if axes is not None and name not in axes:
+        for phase in self.plan.axis_phases:
+            if axes is not None and phase.axis not in axes:
                 continue
-            block = self._axis_phase(block, name, adim)
+            block = self._axis_phase(block, phase)
         return block
 
     @cached_property
@@ -480,28 +477,24 @@ class HaloExchange:
         p = self.spec.padded()
         return (self.resident.z * p.z, p.y, p.x)
 
-    def _axis_phase(self, block, name: str, adim: int):
-        spec = self.spec
-        sizes, rm, rp, off = _spec_axis(spec, name)
-        if rm == 0 and rp == 0:
+    def _axis_phase(self, block, phase):
+        if not phase.active:
             return block
-        c = {AXIS_Z: self.resident.z, AXIS_Y: self.resident.y,
-             AXIS_X: self.resident.x}[name]
-        if c > 1:
-            return self._axis_phase_resident(block, name, adim, c)
+        if phase.resident > 1:
+            return self._axis_phase_resident(block, phase)
         if (
-            len(sizes) == 1
+            phase.blocks == 1
             and block.dtype == jnp.float32
-            and name in self._self_fills
+            and phase.axis in self._self_fills
         ):
             # self-wrap axis: fill halos in place, touching only the edge
             # tiles, instead of materializing slabs + whole-array updates
-            return self._self_fills[name](
+            return self._self_fills[phase.axis](
                 block.reshape(self._fill_shape())
             ).reshape(block.shape)
         # the slab movement itself is the batched body's Q=1 degeneration
         # (pack_slabs is the identity there) — one copy of the geometry
-        return self._axis_phase_batched([block], name, adim)[0]
+        return self._axis_phase_batched([block], phase)[0]
 
     def _resident_sizes(self, name: str, c: int):
         """This device's ``c`` resident block sizes along one axis: static
@@ -515,8 +508,8 @@ class HaloExchange:
         idx = lax.axis_index(name)
         return [tbl[idx * c + j] for j in range(c)]
 
-    def _axis_phase_resident(self, block, name: str, adim: int, c: int):
-        """Axis phase with ``c`` partition blocks resident per device along
+    def _axis_phase_resident(self, block, phase):
+        """Axis phase with partition blocks resident per device along
         this axis (oversubscription). Neighbor slabs between resident
         blocks shift along the stacked block dim — a pure local copy, the
         analogue of the reference's same-GPU ``PeerAccessSender``
@@ -524,10 +517,10 @@ class HaloExchange:
         slabs ride the collective permute. Works on any axis, uneven
         splits included (per-resident sizes may be traced scalars).
         Implemented as the batched body's Q=1 degeneration."""
-        return self._axis_phase_resident_batched([block], name, adim, c)[0]
+        return self._axis_phase_resident_batched([block], phase)[0]
 
     # -- quantity-batched phases (packed carriers) ---------------------------
-    def _axis_phase_batched(self, blocks, name: str, adim: int):
+    def _axis_phase_batched(self, blocks, phase):
         """One composed axis phase for a same-dtype quantity group: every
         quantity's boundary slab is gathered and stacked into one packed
         ``(Q, ...slab)`` carrier, and ONE ``ppermute`` pair moves the
@@ -539,24 +532,23 @@ class HaloExchange:
         Pallas fills upstream). Bit-identical to the per-quantity phases —
         the exchange is pure data movement. Q=1 degenerates to the exact
         historical per-quantity program (pack_slabs is the identity then),
-        so :meth:`_axis_phase` delegates here — one copy of the geometry."""
-        spec = self.spec
-        sizes, rm, rp, off = _spec_axis(spec, name)
+        so :meth:`_axis_phase` delegates here — one copy of the geometry.
+        All geometry (size table, permute pairs, radii, offsets) comes
+        from the phase record of the ExchangePlan IR."""
+        rm, rp, off, adim = phase.rm, phase.rp, phase.offset, phase.adim
         if rm == 0 and rp == 0:
             return blocks
         from ..ops.halo_fill import pack_slabs, unpack_slabs
 
-        c = {AXIS_Z: self.resident.z, AXIS_Y: self.resident.y,
-             AXIS_X: self.resident.x}[name]
-        if c > 1:
-            return self._axis_phase_resident_batched(blocks, name, adim, c)
-        n = len(sizes)
-        if len(set(sizes)) == 1:
-            sz = sizes[0]
+        if phase.resident > 1:
+            return self._axis_phase_resident_batched(blocks, phase)
+        name = phase.axis
+        n = phase.ring
+        if phase.uniform:
+            sz = phase.sizes[0]
         else:
-            sz = jnp.asarray(sizes, dtype=jnp.int32)[lax.axis_index(name)]
-        fwd = [(i, (i + 1) % n) for i in range(n)]
-        bwd = [(i, (i - 1) % n) for i in range(n)]
+            sz = jnp.asarray(phase.sizes, dtype=jnp.int32)[lax.axis_index(name)]
+        fwd, bwd = phase.fwd, phase.bwd
         nq = len(blocks)
         if rm > 0:
             carrier = pack_slabs(
@@ -580,7 +572,7 @@ class HaloExchange:
             ]
         return blocks
 
-    def _axis_phase_resident_batched(self, blocks, name: str, adim: int, c: int):
+    def _axis_phase_resident_batched(self, blocks, phase):
         """:meth:`_axis_phase_resident` for a same-dtype group:
         resident-neighbor slabs stay per-quantity local copies (they never
         were collectives), and the two boundary slabs of ALL quantities
@@ -588,12 +580,10 @@ class HaloExchange:
         pair per phase regardless of Q."""
         from ..ops.halo_fill import pack_slabs, unpack_slabs
 
-        spec = self.spec
-        sizes, rm, rp, off = _spec_axis(spec, name)
-        bdim = _BDIM[name]
-        m = len(sizes) // c
-        fwd = [(i, (i + 1) % m) for i in range(m)]
-        bwd = [(i, (i - 1) % m) for i in range(m)]
+        name, adim, bdim = phase.axis, phase.adim, phase.bdim
+        rm, rp, off, c = phase.rm, phase.rp, phase.offset, phase.resident
+        m = phase.ring
+        fwd, bwd = phase.fwd, phase.bwd
         sz = self._resident_sizes(name, c)
         nq = len(blocks)
 
@@ -655,17 +645,31 @@ class HaloExchange:
         Called under ``jax.jit`` on ``P('z','y','x')``-sharded arrays (see
         :attr:`_compiled`); also safe to trace inside larger global jitted
         steps (ops/jacobi.py's AUTO_SPMD path)."""
-        for name, adim, _ in _AXES:
-            arr = self._auto_axis_phase(arr, name, adim)
+        for phase in self._auto_plan.axis_phases:
+            arr = self._auto_axis_phase(arr, phase)
         return arr
 
-    def _auto_axis_phase(self, arr, name: str, adim: int):
-        sizes, rm, rp, off = _spec_axis(self.spec, name)
+    @cached_property
+    def _auto_plan(self):
+        """Axis phases in synthesized form (ring spans the FULL per-axis
+        block table — the global roll program has no resident concept; the
+        partitioner turns shard-internal shifts into local copies on its
+        own). :attr:`plan` equals this when the method IS auto-spmd; the
+        manual methods still need it for :meth:`auto_fill` composition."""
+        if self.method == Method.AUTO_SPMD:
+            return self.plan
+        return build_plan(
+            self.spec, mesh_dim(self.mesh), Method.AUTO_SPMD,
+            batch_quantities=self.batch_quantities, resident=self.resident,
+        )
+
+    def _auto_axis_phase(self, arr, phase):
+        sizes, rm, rp, off = phase.sizes, phase.rm, phase.rp, phase.offset
         if rm == 0 and rp == 0:
             return arr
-        bdim = _BDIM[name]
+        adim, bdim = phase.adim, phase.bdim
         n = len(sizes)
-        if len(set(sizes)) == 1:
+        if phase.uniform:
             sz = sizes[0]
             if rm > 0:
                 # every block's top rm planes -> its +neighbor's low halo:
@@ -729,54 +733,24 @@ class HaloExchange:
             return self._direct26_batched_uneven(blocks)
         from ..ops.halo_fill import pack_slabs, unpack_slabs
 
-        spec = self.spec
-        sz = spec.base  # uniform
-        r = spec.radius
-        off = spec.compute_offset()
         cz, cy, cx = self.resident.z, self.resident.y, self.resident.x
         nq = len(blocks)
         boff = 1 if nq > 1 else 0  # the packed carrier's leading Q axis
         updates = []
-        for d in DIRECTIONS_26:
-            if r.dir(-d) == 0:
-                continue
-            starts = []
-            dsts = []
-            shape = []
-            for dc, s, rmin, rplus, o in zip(
-                (d.z, d.y, d.x),
-                (sz.z, sz.y, sz.x),
-                (r.z(-1), r.y(-1), r.x(-1)),
-                (r.z(1), r.y(1), r.x(1)),
-                (off.z, off.y, off.x),
-            ):
-                if dc == 1:
-                    starts.append(o + s - rmin)
-                    dsts.append(o - rmin)
-                    shape.append(rmin)
-                elif dc == -1:
-                    starts.append(o)
-                    dsts.append(o + s)
-                    shape.append(rplus)
-                else:
-                    starts.append(o)
-                    dsts.append(o)
-                    shape.append(s)
-            if any(e == 0 for e in shape):
-                continue
+        for ph in self.plan.direct_phases:
             carrier = pack_slabs([
                 lax.dynamic_slice(
-                    b, (0, 0, 0) + tuple(starts), (cz, cy, cx) + tuple(shape)
+                    b, (0, 0, 0) + ph.src, (cz, cy, cx) + ph.shape
                 )
                 for b in blocks
             ])
-            carrier = self._roll_blocks(carrier, d, boff=boff)
-            updates.append((carrier, dsts))
+            carrier = self._roll_blocks(carrier, ph, boff=boff)
+            updates.append((carrier, ph.dst))
         out = list(blocks)
         for carrier, dsts in updates:
             for q, piece in enumerate(unpack_slabs(carrier, nq)):
                 out[q] = lax.dynamic_update_slice(
-                    out[q], piece, (0, 0, 0) + tuple(dsts)
+                    out[q], piece, (0, 0, 0) + dsts
                 )
         return out
 
@@ -811,9 +785,10 @@ class HaloExchange:
         nq = len(blocks)
         boff = 1 if nq > 1 else 0  # the packed carrier's leading Q axis
         out = list(blocks)
-        dirs = [d for d in DIRECTIONS_26 if r.dir(-d) != 0]
-        dirs.sort(key=lambda d: abs(d.x) + abs(d.y) + abs(d.z))
-        for d in dirs:
+        # plan phases arrive pre-sorted face -> edge -> corner with zero-
+        # extent directions dropped and base-padded static carrier shapes
+        for ph in self.plan.direct_phases:
+            d = Dim3.of(ph.direction)
             info = tuple(zip(
                 (d.z, d.y, d.x),
                 (off.z, off.y, off.x),
@@ -821,12 +796,7 @@ class HaloExchange:
                 (r.z(1), r.y(1), r.x(1)),
                 (base.z, base.y, base.x),
             ))
-            shape = tuple(
-                rm if dc == 1 else rp if dc == -1 else b
-                for dc, _o, rm, rp, b in info
-            )
-            if any(e == 0 for e in shape):
-                continue
+            shape = ph.shape
 
             def gather(block):
                 parts_z = []
@@ -849,7 +819,7 @@ class HaloExchange:
                 return _concat(parts_z, 0)
 
             carrier = self._roll_blocks(
-                pack_slabs([gather(b) for b in out]), d, boff=boff
+                pack_slabs([gather(b) for b in out]), ph, boff=boff
             )
             for q, slab in enumerate(unpack_slabs(carrier, nq)):
                 for jz in range(cz):
@@ -869,16 +839,18 @@ class HaloExchange:
                             )
         return out
 
-    def _roll_blocks(self, slab, d: Dim3, boff: int = 0):
-        """Send each resident block's slab to its ``+d`` neighbor in the
-        GLOBAL block grid: without oversubscription this is the single
-        diagonal 26-neighbor permute; with residents each axis shifts the
-        stacked block dim locally and only the wrap-around boundary rides
-        an axis permute (the per-axis composition of the same move).
-        ``boff``: leading batch axes before the block dims (the packed
-        ``(Q, ...)`` carrier of the quantity-batched path)."""
+    def _roll_blocks(self, slab, ph, boff: int = 0):
+        """Send each resident block's slab to its ``+direction`` neighbor
+        in the GLOBAL block grid: without oversubscription this is the
+        single diagonal 26-neighbor permute (the phase record carries the
+        flattened pairs); with residents each axis shifts the stacked
+        block dim locally and only the wrap-around boundary rides an axis
+        permute (the per-axis composition of the same move). ``boff``:
+        leading batch axes before the block dims (the packed ``(Q, ...)``
+        carrier of the quantity-batched path)."""
+        d = Dim3.of(ph.direction)
         if not self.oversubscribed:
-            return lax.ppermute(slab, (AXIS_Z, AXIS_Y, AXIS_X), self._perm26(d))
+            return lax.ppermute(slab, (AXIS_Z, AXIS_Y, AXIS_X), ph.pairs)
         md = mesh_dim(self.mesh)
         for name, bdim, comp, m, c in (
             (AXIS_Z, boff + 0, d.z, md.z, self.resident.z),
@@ -907,21 +879,6 @@ class HaloExchange:
                     [lax.slice_in_dim(slab, 1, c, axis=bdim), first], axis=bdim
                 )
         return slab
-
-    def _perm26(self, d: Dim3) -> Tuple[Tuple[int, int], ...]:
-        """Flattened (z, y, x)-major permutation sending toward ``d``
-        (one block per device — mesh dims == partition dims)."""
-        nd = self.spec.dim
-        pairs = []
-        for iz in range(nd.z):
-            for iy in range(nd.y):
-                for ix in range(nd.x):
-                    src = (iz * nd.y + iy) * nd.x + ix
-                    jz, jy, jx = (iz + d.z) % nd.z, (iy + d.y) % nd.y, (ix + d.x) % nd.x
-                    dst = (jz * nd.y + jy) * nd.x + jx
-                    pairs.append((src, dst))
-        return tuple(pairs)
-
 
 def _starts(ndim: int, start, adim: int):
     """Per-dim start indices, uniformly int32 (mixed Python-int / traced-scalar

@@ -1,0 +1,258 @@
+"""Static exchange-plan cost model — rank candidates without compiling.
+
+The model scores one :class:`~stencil_tpu.plan.ir.PlanChoice` for one
+:class:`~stencil_tpu.plan.ir.PlanConfig` from the ExchangePlan IR alone:
+collective-permute count, estimated on-wire bytes, and local slab bytes
+fall out of the phase list (plan/ir.py), and the per-collective overhead
+constants are calibrated from the censuses + wall-clocks this repo has
+RECORDED (BASELINE.md rounds 7/10, 8-device CPU mesh, jax 0.4.37):
+
+- Round 10 quantity-batching A/B (128^3, 2x2x2, fp32): Q=8 batched
+  42.9 ms / 6 permutes vs per-quantity 70.6 ms / 48 permutes — the
+  42-permute delta prices one composed ppermute at ~0.66 ms.
+- Round 7 ablation (same leg, Q=4): composed 47.6 ms / 24 permutes /
+  12.48 MB on-wire. Subtracting 24 x 0.66 ms leaves ~32 ms for the
+  payload -> ~390 MB/s effective wire bandwidth.
+- direct26: 200.7 ms / 104 permutes / 6.69 MB. With the same wire rate,
+  the residual prices a direct26 permute at ~1.76 ms — the exact-extent
+  messages are small and strided, so their per-collective overhead is
+  ~2.7x the slab phases' (the reference found the same economics for
+  many small MPI messages vs packed slabs).
+- auto-spmd: 49.5 ms for the identical 24-permute/12.48 MB schedule ->
+  ~0.73 ms per synthesized permute (manual wins ~4%).
+
+These are RANKING constants, not performance claims: per-collective
+overhead dominating payload is the recorded regime on this stack, and the
+model's job is ordering candidates for the measured refinement pass
+(plan/probe.py). A TPU-measured recalibration is the ROADMAP #1 ledger's
+follow-up; ``calibration=`` overrides let a probe session supply one.
+
+This module is jax-free: scoring builds GridSpecs and ExchangePlans
+(pure geometry), so enumerating hundreds of candidates costs
+milliseconds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..domain.grid import GridSpec
+from ..geometry import Dim3, Radius, stack_residents
+from .ir import (
+    AUTO_SPMD,
+    AXIS_COMPOSED,
+    DIRECT26,
+    METHODS,
+    PlanChoice,
+    PlanConfig,
+    build_plan,
+)
+
+# Calibration provenance: BASELINE.md rounds 7/10 (see module docstring).
+DEFAULT_CALIBRATION: Dict[str, object] = {
+    "permute_overhead_s": {
+        AXIS_COMPOSED: 6.6e-4,
+        DIRECT26: 1.76e-3,
+        AUTO_SPMD: 7.3e-4,
+    },
+    "wire_bytes_per_s": 3.9e8,
+    "local_bytes_per_s": 4.0e9,
+    # per-cell update cost for the multistep redundant-compute tradeoff
+    # (order-of-magnitude CPU figure; the probe pass owns the truth)
+    "cell_update_s": 1.0e-9,
+    # relative compute factor per kernel variant (unknown -> 1.0: the
+    # static model deliberately ties variants and lets the probes decide)
+    "variant_factor": {},
+}
+
+
+@dataclass(frozen=True)
+class PlanCost:
+    """Static score of one candidate, per simulation step."""
+
+    total_s: float          # the ranking key
+    exchange_s: float       # one exchange's predicted wall-clock
+    collectives: int        # permutes per exchange (census-comparable)
+    wire_bytes: int         # estimated interconnect bytes per exchange
+    local_bytes: int        # estimated local slab bytes per exchange
+    compute_overhead_s: float  # multistep redundant-compute price per step
+
+    def to_json(self) -> dict:
+        return {
+            "total_s": self.total_s,
+            "exchange_s": self.exchange_s,
+            "collectives": self.collectives,
+            "wire_bytes": self.wire_bytes,
+            "local_bytes": self.local_bytes,
+            "compute_overhead_s": self.compute_overhead_s,
+        }
+
+
+def scale_radius(radius: Radius, k: int) -> Radius:
+    """The radius a temporal-depth-k multistep realizes: every direction's
+    halo (and diagonal gate) scaled by k, so one exchange feeds k steps."""
+    if k == 1:
+        return radius
+    out = Radius.constant(0)
+    for d, r in radius._r.items():
+        out.set_dir(d, r * k)
+    return out
+
+
+def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
+    """(spec, mesh_dim, resident) when the candidate can realize on this
+    config, else None. Mirrors realize()'s constraints exactly: the
+    partition's block count must be a multiple of ndev (residents stacked
+    by the same z-heavy factorization), and no block may be thinner than
+    the effective radius."""
+    dim = Dim3.of(choice.partition)
+    g = Dim3.of(config.grid)
+    if g.x < dim.x or g.y < dim.y or g.z < dim.z:
+        return None
+    nb = dim.flatten()
+    if nb % config.ndev:
+        return None
+    radius = scale_radius(config.radius_obj(), choice.multistep_k)
+    try:
+        spec = GridSpec(g, dim, radius)
+    except AssertionError:
+        return None
+    c = nb // config.ndev
+    if c == 1:
+        mesh_dim = dim
+    else:
+        try:
+            mesh_dim = stack_residents(dim, c)
+        except ValueError:
+            return None
+    for sizes, rm, rp in (
+        (spec.sizes_x, radius.x(-1), radius.x(1)),
+        (spec.sizes_y, radius.y(-1), radius.y(1)),
+        (spec.sizes_z, radius.z(-1), radius.z(1)),
+    ):
+        if min(sizes) < max(rm, rp):
+            return None  # halo would span multiple blocks
+    resident = Dim3(dim.x // mesh_dim.x, dim.y // mesh_dim.y,
+                    dim.z // mesh_dim.z)
+    return spec, mesh_dim, resident
+
+
+def score(config: PlanConfig, choice: PlanChoice,
+          calibration: Optional[dict] = None) -> Optional[PlanCost]:
+    """Static per-step cost of one candidate (None when infeasible).
+
+    The score is a function of the dtype MULTISET only — a config whose
+    quantity list is a permutation of another's scores identically, so
+    the ranking is invariant under quantity-dtype permutation
+    (tests/test_plan_cost.py pins this)."""
+    cal = dict(DEFAULT_CALIBRATION)
+    for k, v in (calibration or {}).items():
+        # dict-valued keys (per-method overheads, variant factors) merge
+        # per entry so a partial override falls back to the defaults for
+        # every method it does not mention
+        if isinstance(v, dict) and isinstance(cal.get(k), dict):
+            cal[k] = {**cal[k], **v}
+        else:
+            cal[k] = v
+    feas = feasible(config, choice)
+    if feas is None:
+        return None
+    spec, mesh_dim, resident = feas
+    plan = build_plan(spec, mesh_dim, choice.method,
+                      batch_quantities=choice.batch_quantities,
+                      resident=resident)
+    itemsizes = config.itemsizes()
+    nq = config.num_quantities
+    ngroups = config.dtype_group_count
+    collectives = plan.collectives_per_exchange(nq, ngroups)
+    wire = plan.wire_bytes(itemsizes)
+    local = plan.local_bytes(itemsizes)
+    overhead = cal["permute_overhead_s"][choice.method]
+    exchange_s = (
+        collectives * overhead
+        + wire / cal["wire_bytes_per_s"]
+        + local / cal["local_bytes_per_s"]
+    )
+    k = choice.multistep_k
+    compute_overhead_s = 0.0
+    if k > 1:
+        # deep halos trade collective count for redundant edge compute:
+        # each of the k-1 interior steps re-updates a shrinking halo
+        # shell; the average extra shell is ~ (k-1)/2 radius-deep over
+        # every block face
+        b = spec.base
+        r0 = config.radius_obj()
+        rbar = (r0.x(-1) + r0.x(1) + r0.y(-1) + r0.y(1)
+                + r0.z(-1) + r0.z(1)) / 6.0
+        surface = 2 * (b.x * b.y + b.x * b.z + b.y * b.z) * spec.num_blocks()
+        extra_cells = surface * rbar * (k - 1) / 2.0
+        compute_overhead_s = extra_cells * nq * cal["cell_update_s"]
+    vf = cal["variant_factor"].get(choice.kernel_variant, 1.0)
+    total = exchange_s / k + compute_overhead_s * vf
+    return PlanCost(
+        total_s=total, exchange_s=exchange_s, collectives=collectives,
+        wire_bytes=wire, local_bytes=local,
+        compute_overhead_s=compute_overhead_s,
+    )
+
+
+def candidate_partitions(config: PlanConfig,
+                         oversubscribe: Sequence[int] = (1,)) -> List[Tuple[int, int, int]]:
+    """All (px, py, pz) block grids with ndev * c blocks (c in
+    ``oversubscribe``), unfiltered for radius feasibility (score() is the
+    gate). Ordered deterministically."""
+    out = []
+    for c in oversubscribe:
+        n = config.ndev * c
+        for px in range(1, n + 1):
+            if n % px:
+                continue
+            nyz = n // px
+            for py in range(1, nyz + 1):
+                if nyz % py:
+                    continue
+                out.append((px, py, nyz // py))
+    return out
+
+
+def enumerate_candidates(
+    config: PlanConfig,
+    methods: Iterable[str] = METHODS,
+    batch_options: Iterable[bool] = (True, False),
+    ks: Iterable[int] = (1,),
+    variants: Iterable[Optional[str]] = (None,),
+    oversubscribe: Sequence[int] = (1,),
+) -> List[PlanChoice]:
+    """The search space: partition shape x method x quantity batching x
+    temporal depth k x kernel variant. Batching only branches when the
+    config has more than one quantity (at Q=1 the two programs are
+    identical — PR 5's degeneration contract)."""
+    if config.num_quantities <= 1:
+        batch_options = (True,)
+    out = []
+    for part in candidate_partitions(config, oversubscribe):
+        for method in methods:
+            for batch in batch_options:
+                for k in ks:
+                    for variant in variants:
+                        out.append(PlanChoice(
+                            partition=part, method=method,
+                            batch_quantities=batch, multistep_k=k,
+                            kernel_variant=variant,
+                        ))
+    return out
+
+
+def rank(config: PlanConfig, candidates: Iterable[PlanChoice],
+         calibration: Optional[dict] = None) -> List[Tuple[PlanCost, PlanChoice]]:
+    """Feasible candidates sorted cheapest-first. Ties break on the
+    choice label so the order is total and deterministic (the
+    permutation-invariance property needs a stable ranking)."""
+    scored = []
+    for choice in candidates:
+        c = score(config, choice, calibration)
+        if c is not None:
+            scored.append((c, choice))
+    scored.sort(key=lambda t: (t[0].total_s, t[1].label()))
+    return scored

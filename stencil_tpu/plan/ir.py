@@ -1,0 +1,512 @@
+"""ExchangePlan IR — the declarative form of a halo exchange.
+
+Historically ``parallel/exchange.py`` branched three ways on ``Method``
+and recomputed its geometry (axis tables, permute pairs, slab extents)
+inline in each lowering body. This module lifts that geometry into a
+small declarative plan — phases, directions, pack-group policy, carrier
+dtypes, permute pairs — that AXIS_COMPOSED, DIRECT26 *and* AUTO_SPMD all
+lower from (the reference analogue: the 26-direction transport plan
+``realize`` builds before any sender exists, src/stencil.cu:327-464).
+
+Why an IR at all: the autotuner (plan/cost.py, plan/autotune.py)
+searches (partition shape x method x quantity batching x temporal k x
+kernel variant). With the plan as data, a candidate is *described and
+costed without compiling it* — collective counts and on-wire bytes fall
+out of the phase list — and the lowering stays a single code path per
+phase kind. ROADMAP #2's ``Method.REMOTE_DMA`` becomes another lowering
+of the same phases.
+
+The IR is pure geometry: building a plan touches no jax and no devices,
+so the cost model can enumerate hundreds of candidates cheaply. The
+lowering in ``HaloExchange`` is required to compile bit-identically to
+the historical method branches — pinned by the census pins and parity
+fixtures in tests/test_plan_ir.py and tests/test_exchange*.py.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from ..geometry import DIRECTIONS_26, Dim3, Radius
+
+# Method value strings (mirrors parallel.exchange.Method — the IR must not
+# import the lowering module, which imports this one).
+AXIS_COMPOSED = "axis-composed"
+DIRECT26 = "direct26"
+AUTO_SPMD = "auto-spmd"
+METHODS = (AXIS_COMPOSED, DIRECT26, AUTO_SPMD)
+
+# (axis name, stacked-array data dim, block dim) in exchange-phase order —
+# the one authority for phase order; exchange.py consumes it via the plan.
+AXIS_ORDER = (("x", 5, 2), ("y", 4, 1), ("z", 3, 0))
+
+
+@dataclass(frozen=True)
+class AxisPhaseIR:
+    """One composed axis phase (or one AUTO_SPMD roll phase).
+
+    ``sizes`` is the full per-axis block-size table (length ``ring *
+    resident``); ``ring`` is the number of permute participants along the
+    mesh axis; ``resident`` the oversubscription factor (blocks stacked
+    per device). ``fwd``/``bwd`` are the literal ``lax.ppermute`` pair
+    lists toward +axis/-axis (empty when the phase is local-only or the
+    schedule is partitioner-synthesized).
+    """
+
+    axis: str               # 'x' | 'y' | 'z' (mesh axis name)
+    adim: int               # stacked-array data dim
+    bdim: int               # stacked-array block dim
+    ring: int               # permute participants along this axis
+    resident: int           # blocks resident per device along this axis
+    rm: int                 # low-side radius (data received from -axis)
+    rp: int                 # high-side radius
+    offset: int             # allocation-local compute origin on this axis
+    sizes: Tuple[int, ...]  # per-block logical sizes (full table)
+    fwd: Tuple[Tuple[int, int], ...]
+    bwd: Tuple[Tuple[int, int], ...]
+    wire_cells: int         # cells permuted per exchange per quantity (all devices)
+    local_cells: int        # cells moved locally (self-wrap / resident shifts)
+
+    @property
+    def blocks(self) -> int:
+        return self.ring * self.resident
+
+    @property
+    def uniform(self) -> bool:
+        return len(set(self.sizes)) == 1
+
+    @property
+    def active(self) -> bool:
+        return self.rm > 0 or self.rp > 0
+
+    def collectives(self) -> int:
+        """ppermutes one lowering of this phase emits (per carrier)."""
+        if self.ring <= 1 or not self.active:
+            return 0
+        return (1 if self.rm > 0 else 0) + (1 if self.rp > 0 else 0)
+
+
+@dataclass(frozen=True)
+class DirectPhaseIR:
+    """One DIRECT26 direction message.
+
+    ``src``/``dst`` are static allocation-local (z, y, x) starts on a
+    uniform partition; on uneven partitions they are traced per-block
+    size-table lookups at lowering time, and ``shape`` is the base-padded
+    static carrier extent every permute participant shares. ``pairs`` is
+    the flattened 26-neighbor permutation when the mesh matches the
+    partition (no oversubscription); with residents the lowering composes
+    per-axis rolls instead (see HaloExchange._roll_blocks).
+    """
+
+    direction: Tuple[int, int, int]       # (dx, dy, dz)
+    shape: Tuple[int, int, int]           # carrier extent (z, y, x)
+    src: Optional[Tuple[int, int, int]]   # uniform-only static starts (z, y, x)
+    dst: Optional[Tuple[int, int, int]]
+    pairs: Tuple[Tuple[int, int], ...]    # flattened permute pairs (may be ())
+    collective_count: int                 # permutes per carrier for this message
+    wire_cells: int
+    local_cells: int
+
+    def collectives(self) -> int:
+        return self.collective_count
+
+
+@dataclass(frozen=True)
+class ExchangePlan:
+    """The full declarative exchange program for one (spec, mesh, method).
+
+    ``pack_groups`` is the carrier policy: ``"dtype"`` packs every
+    same-dtype quantity's slab into one carrier per collective (PR 5's
+    batched bodies — the collective count is Q-independent),
+    ``"quantity"`` is the historical one-collective-per-quantity program.
+    AUTO_SPMD plans are ``synthesized``: the phase list describes the
+    slab program handed to the SPMD partitioner, which owns the actual
+    collective schedule (and emits per-quantity permutes today — the
+    round-7 census).
+    """
+
+    method: str
+    pack_groups: str                      # 'dtype' | 'quantity'
+    partition: Tuple[int, int, int]       # blocks (x, y, z)
+    mesh_dim: Tuple[int, int, int]        # devices (x, y, z)
+    resident: Tuple[int, int, int]
+    axis_phases: Tuple[AxisPhaseIR, ...]  # always built (composed geometry)
+    direct_phases: Tuple[DirectPhaseIR, ...] = ()
+    synthesized: bool = False
+
+    @property
+    def batch_quantities(self) -> bool:
+        return self.pack_groups == "dtype"
+
+    @property
+    def phases(self) -> Tuple:
+        return self.direct_phases if self.method == DIRECT26 else self.axis_phases
+
+    def collectives_per_exchange(self, quantities: int = 1,
+                                 dtype_groups: int = 1) -> int:
+        """Predicted collective-permute count of one compiled exchange —
+        the number the census pins (6 composed / <=26 direct26 on a
+        one-block-per-device mesh; Q-independent when pack_groups='dtype').
+        AUTO_SPMD is predicted from the round-7 finding: the partitioner
+        reinvents the composed schedule, per quantity."""
+        carriers = dtype_groups if self.batch_quantities else quantities
+        if self.synthesized:
+            carriers = quantities  # the partitioner packs nothing today
+        return sum(p.collectives() for p in self.phases) * carriers
+
+    def wire_bytes(self, itemsizes: Sequence[int]) -> int:
+        """Estimated bytes on the interconnect per exchange (all
+        quantities). Exact on one-block-per-device meshes; under
+        oversubscription DIRECT26 carriers are counted whole although
+        resident-internal shifts stay local (a deliberate overestimate —
+        the census remains the compile-time truth)."""
+        per_cell = sum(itemsizes)
+        return sum(p.wire_cells for p in self.phases) * per_cell
+
+    def local_bytes(self, itemsizes: Sequence[int]) -> int:
+        """Estimated bytes moved without touching the interconnect
+        (self-wrap fills, resident-neighbor shifts)."""
+        per_cell = sum(itemsizes)
+        return sum(p.local_cells for p in self.phases) * per_cell
+
+    def describe(self) -> str:
+        """Human-readable plan dump (plan_tool explain)."""
+        lines = [
+            f"method={self.method} pack_groups={self.pack_groups} "
+            f"partition={self.partition} mesh={self.mesh_dim} "
+            f"resident={self.resident}"
+            + (" (schedule synthesized by the SPMD partitioner)"
+               if self.synthesized else ""),
+        ]
+        for p in self.phases:
+            if isinstance(p, AxisPhaseIR):
+                lines.append(
+                    f"  axis {p.axis}: ring={p.ring} resident={p.resident} "
+                    f"rm={p.rm} rp={p.rp} permutes={p.collectives()} "
+                    f"wire_cells={p.wire_cells} local_cells={p.local_cells}"
+                )
+            else:
+                lines.append(
+                    f"  dir {p.direction}: shape(zyx)={p.shape} "
+                    f"permutes={p.collectives()} wire_cells={p.wire_cells}"
+                )
+        lines.append(
+            f"  total permutes/exchange (1 group): "
+            f"{self.collectives_per_exchange()}"
+        )
+        return "\n".join(lines)
+
+
+# -- plan construction --------------------------------------------------------
+
+
+def spec_axis(spec, name: str):
+    """(per-index sizes, low radius, high radius, compute offset) along
+    one axis — THE axis-geometry accessor: the plan builder below and the
+    lowering in parallel/exchange.py both import this one function, so
+    predicted and lowered geometry cannot desynchronize. The offset can
+    exceed the low radius in aligned layouts (the y compute origin is
+    rounded to the 8-row tile); the halo always sits immediately adjacent
+    to the compute region, at [offset - rm, offset)."""
+    off = spec.compute_offset()
+    if name == "x":
+        return spec.sizes_x, spec.radius.x(-1), spec.radius.x(1), off.x
+    if name == "y":
+        return spec.sizes_y, spec.radius.y(-1), spec.radius.y(1), off.y
+    return spec.sizes_z, spec.radius.z(-1), spec.radius.z(1), off.z
+
+
+def _ring_pairs(n: int) -> Tuple[Tuple[Tuple[int, int], ...],
+                                 Tuple[Tuple[int, int], ...]]:
+    fwd = tuple((i, (i + 1) % n) for i in range(n))
+    bwd = tuple((i, (i - 1) % n) for i in range(n))
+    return fwd, bwd
+
+
+def _perm26(dim: Dim3, d: Dim3) -> Tuple[Tuple[int, int], ...]:
+    """Flattened (z, y, x)-major permutation sending toward ``d`` (one
+    block per device — mesh dims == partition dims)."""
+    pairs = []
+    for iz in range(dim.z):
+        for iy in range(dim.y):
+            for ix in range(dim.x):
+                src = (iz * dim.y + iy) * dim.x + ix
+                jz = (iz + d.z) % dim.z
+                jy = (iy + d.y) % dim.y
+                jx = (ix + d.x) % dim.x
+                pairs.append((src, (jz * dim.y + jy) * dim.x + jx))
+    return tuple(pairs)
+
+
+def _axis_phases(spec, mesh_dim: Dim3, resident: Dim3,
+                 synthesized: bool) -> Tuple[AxisPhaseIR, ...]:
+    p = spec.padded()
+    orth = {  # padded cells orthogonal to each axis, per block
+        "x": p.y * p.z,
+        "y": p.x * p.z,
+        "z": p.x * p.y,
+    }
+    res = {"x": resident.x, "y": resident.y, "z": resident.z}
+    md = {"x": mesh_dim.x, "y": mesh_dim.y, "z": mesh_dim.z}
+    nblocks = spec.num_blocks()
+    phases = []
+    for name, adim, bdim in AXIS_ORDER:
+        sizes, rm, rp, off = spec_axis(spec, name)
+        c = 1 if synthesized else res[name]
+        ring = len(sizes) if synthesized else md[name]
+        if ring > 1 and not synthesized:
+            fwd, bwd = _ring_pairs(ring)
+        else:
+            fwd, bwd = (), ()
+        slab_cells = (rm + rp) * orth[name] * nblocks  # every block's slabs
+        if ring > 1:
+            if c > 1:
+                # only the two boundary slabs of each device's resident
+                # stack ride the permute; the rest shift locally
+                wire = (rm + rp) * orth[name] * (nblocks // c)
+            else:
+                wire = slab_cells
+        else:
+            wire = 0
+        phases.append(AxisPhaseIR(
+            axis=name, adim=adim, bdim=bdim, ring=ring, resident=c,
+            rm=rm, rp=rp, offset=off, sizes=tuple(sizes),
+            fwd=fwd if not synthesized else (),
+            bwd=bwd if not synthesized else (),
+            wire_cells=wire, local_cells=slab_cells - wire,
+        ))
+    return tuple(phases)
+
+
+def _direct_phases(spec, mesh_dim: Dim3,
+                   resident: Dim3) -> Tuple[DirectPhaseIR, ...]:
+    r = spec.radius
+    off = spec.compute_offset()
+    base = spec.base
+    uniform = spec.is_uniform()
+    oversub = resident != Dim3(1, 1, 1)
+    nblocks = spec.num_blocks()
+    dirs = [d for d in DIRECTIONS_26 if r.dir(-d) != 0]
+    if not uniform:
+        # face -> edge -> corner apply order (stable within each rank)
+        dirs.sort(key=lambda d: abs(d.x) + abs(d.y) + abs(d.z))
+    phases = []
+    for d in dirs:
+        shape, src, dst = [], [], []
+        for dc, s, rmin, rplus, o in zip(
+            (d.z, d.y, d.x),
+            (base.z, base.y, base.x),
+            (r.z(-1), r.y(-1), r.x(-1)),
+            (r.z(1), r.y(1), r.x(1)),
+            (off.z, off.y, off.x),
+        ):
+            if dc == 1:
+                shape.append(rmin)
+                src.append(o + s - rmin)
+                dst.append(o - rmin)
+            elif dc == -1:
+                shape.append(rplus)
+                src.append(o)
+                dst.append(o + s)
+            else:
+                shape.append(s)
+                src.append(o)
+                dst.append(o)
+        if any(e == 0 for e in shape):
+            continue
+        if oversub:
+            # per-axis composition: one permute per nonzero component
+            # whose mesh axis actually has >1 device
+            md = {"z": mesh_dim.z, "y": mesh_dim.y, "x": mesh_dim.x}
+            comp = {"z": d.z, "y": d.y, "x": d.x}
+            count = sum(1 for a in ("z", "y", "x")
+                        if comp[a] != 0 and md[a] > 1)
+            pairs: Tuple[Tuple[int, int], ...] = ()
+        else:
+            count = 1
+            pairs = _perm26(spec.dim, d)
+        cells = shape[0] * shape[1] * shape[2] * nblocks
+        phases.append(DirectPhaseIR(
+            direction=(d.x, d.y, d.z), shape=tuple(shape),
+            src=tuple(src) if uniform else None,
+            dst=tuple(dst) if uniform else None,
+            pairs=pairs, collective_count=count,
+            wire_cells=cells if count else 0,
+            local_cells=0 if count else cells,
+        ))
+    return tuple(phases)
+
+
+def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
+               resident: Optional[Dim3] = None) -> ExchangePlan:
+    """Build the ExchangePlan of one (GridSpec, mesh shape, method).
+
+    Pure geometry — no jax, no devices. ``method`` may be the enum from
+    ``parallel.exchange`` or its value string. ``mesh_dim`` is the device
+    grid (x, y, z); ``resident`` (blocks stacked per device) defaults to
+    ``spec.dim / mesh_dim`` and must divide it exactly.
+    """
+    mval = getattr(method, "value", method)
+    if mval not in METHODS:
+        raise ValueError(f"unknown exchange method {method!r}")
+    md = Dim3.of(mesh_dim)
+    if spec.dim.x % md.x or spec.dim.y % md.y or spec.dim.z % md.z:
+        raise ValueError(
+            f"mesh {md} does not divide partition {spec.dim}"
+        )
+    if resident is None:
+        resident = Dim3(spec.dim.x // md.x, spec.dim.y // md.y,
+                        spec.dim.z // md.z)
+    synthesized = mval == AUTO_SPMD
+    axis_phases = _axis_phases(spec, md, resident, synthesized)
+    direct_phases = (
+        _direct_phases(spec, md, resident) if mval == DIRECT26 else ()
+    )
+    return ExchangePlan(
+        method=mval,
+        pack_groups="dtype" if batch_quantities else "quantity",
+        partition=(spec.dim.x, spec.dim.y, spec.dim.z),
+        mesh_dim=(md.x, md.y, md.z),
+        resident=(resident.x, resident.y, resident.z),
+        axis_phases=axis_phases,
+        direct_phases=direct_phases,
+        synthesized=synthesized,
+    )
+
+
+# -- planner vocabulary: config keys and plan choices -------------------------
+
+
+def radius_dirs(radius: Radius) -> Tuple[Tuple[int, int, int, int], ...]:
+    """Canonical nonzero-direction serialization of a Radius — the same
+    [[dx,dy,dz,r], ...] convention the ckpt manifests record."""
+    return tuple(
+        (d[0], d[1], d[2], r) for d, r in sorted(radius._r.items())
+        if r and d != (0, 0, 0)  # the center cell never exchanges
+    )
+
+
+def radius_from_dirs(dirs) -> Radius:
+    r = Radius.constant(0)
+    for dx, dy, dz, v in dirs:
+        r.set_dir((dx, dy, dz), v)
+    return r
+
+
+@dataclass(frozen=True)
+class PlanConfig:
+    """Canonical problem key: what a tuned plan is valid FOR.
+
+    ``quantities`` is a dtype *multiset* — ``(("float32", 4),)`` — sorted
+    by dtype name, so permuting a domain's quantity declaration order
+    never changes the key (or, by construction, the cost ranking:
+    tests/test_plan_cost.py pins the invariance).
+    """
+
+    grid: Tuple[int, int, int]                       # (x, y, z)
+    radius: Tuple[Tuple[int, int, int, int], ...]    # radius_dirs()
+    quantities: Tuple[Tuple[str, int], ...]          # sorted (dtype, count)
+    ndev: int
+    platform: str = "cpu"
+
+    @classmethod
+    def make(cls, size, radius: Radius, dtypes: Sequence[str], ndev: int,
+             platform: str = "cpu") -> "PlanConfig":
+        size = Dim3.of(size)
+        counts: Dict[str, int] = {}
+        for dt in dtypes:
+            counts[str(dt)] = counts.get(str(dt), 0) + 1
+        return cls(
+            grid=(size.x, size.y, size.z),
+            radius=radius_dirs(radius),
+            quantities=tuple(sorted(counts.items())),
+            ndev=int(ndev),
+            platform=str(platform),
+        )
+
+    @property
+    def num_quantities(self) -> int:
+        return sum(n for _dt, n in self.quantities)
+
+    @property
+    def dtype_group_count(self) -> int:
+        return max(1, len(self.quantities))
+
+    def itemsizes(self) -> Tuple[int, ...]:
+        import numpy as np
+
+        out = []
+        for dt, n in self.quantities:
+            out.extend([np.dtype(dt).itemsize] * n)
+        return tuple(out)
+
+    def radius_obj(self) -> Radius:
+        return radius_from_dirs(self.radius)
+
+    def key(self) -> str:
+        """Stable string key for the plan DB."""
+        return json.dumps({
+            "grid": list(self.grid),
+            "radius": [list(t) for t in self.radius],
+            "quantities": [list(t) for t in self.quantities],
+            "ndev": self.ndev,
+            "platform": self.platform,
+        }, sort_keys=True, separators=(",", ":"))
+
+    def to_json(self) -> dict:
+        return json.loads(self.key())
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "PlanConfig":
+        return cls(
+            grid=tuple(obj["grid"]),
+            radius=tuple(tuple(t) for t in obj["radius"]),
+            quantities=tuple((str(d), int(n)) for d, n in obj["quantities"]),
+            ndev=int(obj["ndev"]),
+            platform=str(obj.get("platform", "cpu")),
+        )
+
+
+@dataclass(frozen=True)
+class PlanChoice:
+    """One point in the search space — what the autotuner picks and the
+    DB persists: partition shape x exchange method x quantity batching x
+    temporal depth k x kernel variant."""
+
+    partition: Tuple[int, int, int]   # blocks (x, y, z)
+    method: str                       # METHODS value string
+    batch_quantities: bool = True
+    multistep_k: int = 1
+    kernel_variant: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return {
+            "partition": list(self.partition),
+            "method": self.method,
+            "batch_quantities": self.batch_quantities,
+            "multistep_k": self.multistep_k,
+            "kernel_variant": self.kernel_variant,
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "PlanChoice":
+        return cls(
+            partition=tuple(obj["partition"]),
+            method=str(obj["method"]),
+            batch_quantities=bool(obj.get("batch_quantities", True)),
+            multistep_k=int(obj.get("multistep_k", 1)),
+            kernel_variant=obj.get("kernel_variant"),
+        )
+
+    def label(self) -> str:
+        px, py, pz = self.partition
+        s = f"{px}x{py}x{pz}/{self.method}"
+        s += "/batched" if self.batch_quantities else "/per-quantity"
+        if self.multistep_k > 1:
+            s += f"/k={self.multistep_k}"
+        if self.kernel_variant:
+            s += f"/{self.kernel_variant}"
+        return s

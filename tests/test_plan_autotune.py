@@ -1,0 +1,181 @@
+"""Autotuner + DistributedDomain wiring + ckpt plan provenance.
+
+The production contracts: a tuned config REPLAYS from the DB with zero
+probes (the cache-hit telemetry proves it), a corrupt DB degrades loudly
+without being clobbered, the domain knobs actually apply the tuned
+choice, and a checkpoint written under one plan warns when revived under
+another. The probing test compiles small 16^3 exchanges on the virtual
+8-device CPU mesh; everything else is backend-free.
+"""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from stencil_tpu.api import DistributedDomain
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel import Method
+from stencil_tpu.plan import db as plandb
+from stencil_tpu.plan.autotune import autotune
+from stencil_tpu.plan.ir import PlanChoice, PlanConfig
+
+
+def test_autotune_probes_then_pure_db_hit(tmp_path):
+    path = str(tmp_path / "plans.json")
+    args = dict(size=(16, 16, 16), radius=Radius.constant(1),
+                dtypes=["float32"] * 2, ndev=8, db_path=path)
+    first = autotune(top_n=2, probe_iters=2, **args)
+    assert not first.cache_hit and first.source == "probe"
+    assert first.probes_run >= 1 and first.candidates > 10
+    assert os.path.exists(path)
+    second = autotune(**args)
+    assert second.cache_hit and second.probes_run == 0
+    assert second.choice == first.choice
+    # the persisted entry carries provenance + probe evidence
+    entry = plandb.lookup(plandb.load_db(path), first.config)
+    assert entry["source"] == "probe"
+    assert any("trimean_s" in p for p in entry["probes"])
+
+
+def test_seeded_entry_replays_without_backend_or_probes(tmp_path):
+    # a seed/DB hit never compiles: ndev+platform are explicit, so the
+    # whole call is file I/O + dict lookups
+    path = str(tmp_path / "plans.json")
+    cfg = PlanConfig.make(Dim3(128, 128, 128), Radius.constant(2),
+                          ["float32"] * 4, 8, "cpu")
+    choice = PlanChoice(partition=(2, 2, 2), method="axis-composed")
+    db = plandb.empty_db()
+    plandb.record(db, plandb.make_entry(cfg, choice, "seed",
+                                        measured_s=0.0262))
+    plandb.save_db(path, db)
+    res = autotune((128, 128, 128), Radius.constant(2), ["float32"] * 4,
+                   ndev=8, platform="cpu", db_path=path)
+    assert res.cache_hit and res.probes_run == 0
+    assert res.choice == choice and res.entry["source"] == "seed"
+
+
+def test_static_only_run_needs_no_probe(tmp_path):
+    res = autotune((64, 64, 64), Radius.constant(2), ["float32"] * 4,
+                   ndev=8, platform="cpu", probe=False,
+                   db_path=str(tmp_path / "p.json"))
+    assert res.source == "static" and res.probes_run == 0
+    assert res.ranked and res.choice == res.ranked[0][1]
+
+
+def test_corrupt_db_degrades_without_clobbering(tmp_path, capfd):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        f.write("{ this is not json")
+    before = open(path).read()
+    res = autotune((64, 64, 64), Radius.constant(2), ["float32"] * 2,
+                   ndev=8, platform="cpu", probe=False, db_path=path)
+    assert res.source == "static"
+    assert open(path).read() == before, "corrupt DB must not be overwritten"
+    assert "rejected" in capfd.readouterr().err
+
+
+def test_domain_set_plan_applies_choice():
+    choice = PlanChoice(partition=(2, 2, 2), method="direct26",
+                        batch_quantities=False)
+    dd = DistributedDomain(16, 16, 16, plan=choice.to_json())
+    dd.set_radius(1)
+    dd.set_devices(jax.devices()[:8])
+    dd.add_data("t", "float32")
+    dd.realize()
+    assert dd._method == Method.DIRECT26
+    assert not dd._batch_quantities
+    assert dd.spec.dim == Dim3(2, 2, 2)
+    assert dd.plan_choice == choice
+    meta = dd.plan_meta()
+    assert meta["choice"]["method"] == "direct26"
+    assert meta["tuned"]
+
+
+def test_domain_autotune_knob_records_result(tmp_path):
+    path = str(tmp_path / "plans.json")
+    dd = DistributedDomain(16, 16, 16, autotune=True, plan_db=path)
+    dd.set_radius(1)
+    dd.set_devices(jax.devices()[:8])
+    dd.add_data("t", "float32")
+    dd.realize()
+    assert dd.autotune_result is not None
+    assert dd.plan_choice == dd.autotune_result.choice
+    assert Dim3.of(dd.plan_choice.partition) == dd.spec.dim
+    # a second domain at the same config replays from the DB
+    dd2 = DistributedDomain(16, 16, 16, autotune=True, plan_db=path)
+    dd2.set_radius(1)
+    dd2.set_devices(jax.devices()[:8])
+    dd2.add_data("t", "float32")
+    dd2.realize()
+    assert dd2.autotune_result.cache_hit
+    assert dd2.autotune_result.probes_run == 0
+    assert dd2.plan_choice == dd.plan_choice
+
+
+def test_explicit_partition_beats_tuned_plan(capfd):
+    choice = PlanChoice(partition=(2, 2, 2), method="direct26")
+    dd = DistributedDomain(16, 16, 16, plan=choice.to_json())
+    dd.set_radius(1)
+    dd.set_devices(jax.devices()[:8])
+    dd.set_partition((1, 2, 4))
+    dd.add_data("t", "float32")
+    dd.realize()
+    assert dd.spec.dim == Dim3(1, 2, 4)
+    assert "overrides" in capfd.readouterr().err
+    # the choice was tuned as a unit: overriding its partition must also
+    # drop its method/batching, not apply them to a partition they were
+    # never measured on
+    assert dd._method == Method.AXIS_COMPOSED
+    assert dd.plan_choice is None and not dd.plan_meta()["tuned"]
+
+
+def test_ckpt_manifest_records_plan_and_resume_warns(tmp_path, capfd):
+    ck = str(tmp_path / "ck")
+
+    def make(method):
+        dd = DistributedDomain(16, 16, 16)
+        dd.set_radius(1)
+        dd.set_methods(method)
+        dd.set_devices(jax.devices()[:8])
+        h = dd.add_data("t", "float32")
+        dd.realize()
+        return dd, h
+
+    dd, h = make(Method.AXIS_COMPOSED)
+    field = np.arange(16 ** 3, dtype=np.float32).reshape(16, 16, 16)
+    dd.set_curr_global(h, field)
+    dd.save_checkpoint(ck, 3, asynchronous=False)
+    # the manifest carries the plan provenance
+    snaps = [e for e in os.listdir(ck) if e.startswith("step-")]
+    manifest = json.load(open(os.path.join(ck, snaps[0], "manifest.json")))
+    plan = manifest["meta"]["plan"]
+    assert plan["choice"]["method"] == "axis-composed"
+    assert plan["key"]["grid"] == [16, 16, 16]
+    capfd.readouterr()
+
+    # same plan -> restores silently
+    dd2, h2 = make(Method.AXIS_COMPOSED)
+    assert dd2.restore_checkpoint(ck) == 3
+    assert "exchange plan" not in capfd.readouterr().err
+    np.testing.assert_array_equal(dd2.get_curr_global(h2), field)
+
+    # different plan -> bit-exact restore, LOUD provenance warning
+    dd3, h3 = make(Method.DIRECT26)
+    assert dd3.restore_checkpoint(ck) == 3
+    err = capfd.readouterr().err
+    assert "exchange plan" in err and "differ" in err
+    np.testing.assert_array_equal(dd3.get_curr_global(h3), field)
+
+
+def test_autotune_without_quantities_warns_and_skips(capfd):
+    # a quantity-less realize() is legal; autotune has nothing to key on
+    # and must skip with a warning instead of crashing
+    dd = DistributedDomain(16, 16, 16, autotune=True)
+    dd.set_radius(1)
+    dd.set_devices(jax.devices()[:8])
+    dd.realize()
+    assert dd.autotune_result is None
+    assert "no quantities" in capfd.readouterr().err

@@ -1,0 +1,146 @@
+"""Plan DB — round-trip, corruption rejection, stale-schema migration.
+
+The DB is the production artifact (tuned plans replayed with zero
+probes), so its failure modes must be LOUD: a corrupt or
+future-versioned file raises PlanDBError instead of silently emptying,
+the known v0 legacy layout migrates forward, and writes are atomic
+(tmp + rename — no torn DB on a crash). No jax anywhere in this file.
+"""
+
+import json
+import os
+
+import pytest
+
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.plan import db as plandb
+from stencil_tpu.plan.ir import PlanChoice, PlanConfig
+
+
+def _config(q=4, grid=(64, 64, 64), platform="cpu"):
+    return PlanConfig.make(Dim3.of(grid), Radius.constant(2),
+                           ["float32"] * q, 8, platform)
+
+
+def _choice():
+    return PlanChoice(partition=(2, 2, 2), method="axis-composed")
+
+
+def test_roundtrip(tmp_path):
+    path = str(tmp_path / "plans.json")
+    db = plandb.empty_db()
+    cfg = _config()
+    entry = plandb.make_entry(cfg, _choice(), "probe", measured_s=0.0262,
+                              probes=[{"label": "x", "trimean_s": 0.03}])
+    plandb.record(db, entry)
+    plandb.save_db(path, db)
+    assert not [e for e in os.listdir(tmp_path) if e.startswith(".tmp-")]
+    loaded = plandb.load_db(path)
+    got = plandb.lookup(loaded, cfg)
+    assert got is not None
+    assert PlanChoice.from_json(got["choice"]) == _choice()
+    assert got["measured_s"] == pytest.approx(0.0262)
+    # a permuted-dtype config resolves to the same entry (multiset key)
+    assert plandb.lookup(loaded, _config()) is got
+
+
+def test_missing_file_is_empty():
+    db = plandb.load_db("/nonexistent/plans.json")
+    assert db == plandb.empty_db()
+
+
+def test_corruption_rejected(tmp_path):
+    path = str(tmp_path / "plans.json")
+    plandb.save_db(path, plandb.empty_db())
+    with open(path, "r+") as f:
+        f.truncate(10)  # torn JSON
+    with pytest.raises(plandb.PlanDBError, match="unreadable"):
+        plandb.load_db(path)
+
+
+def test_wrong_kind_rejected(tmp_path):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        json.dump({"v": 1, "kind": "not-a-plan-db", "entries": {}}, f)
+    with pytest.raises(plandb.PlanDBError):
+        plandb.load_db(path)
+
+
+def test_future_version_rejected(tmp_path):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        json.dump({"v": 99, "kind": plandb.DB_KIND, "entries": {}}, f)
+    with pytest.raises(plandb.PlanDBError, match="newer"):
+        plandb.load_db(path)
+
+
+def test_tampered_entry_rejected(tmp_path):
+    path = str(tmp_path / "plans.json")
+    db = plandb.empty_db()
+    plandb.record(db, plandb.make_entry(_config(), _choice(), "probe"))
+    plandb.save_db(path, db)
+    raw = json.load(open(path))
+    key = next(iter(raw["entries"]))
+    raw["entries"][key]["choice"]["method"] = "warp-drive"
+    with open(path, "w") as f:
+        json.dump(raw, f)
+    with pytest.raises(plandb.PlanDBError, match="method"):
+        plandb.load_db(path)
+
+
+def test_entry_key_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "plans.json")
+    db = plandb.empty_db()
+    plandb.record(db, plandb.make_entry(_config(), _choice(), "probe"))
+    plandb.save_db(path, db)
+    raw = json.load(open(path))
+    key = next(iter(raw["entries"]))
+    raw["entries"]["{}"] = raw["entries"].pop(key)  # moved under a bogus key
+    with open(path, "w") as f:
+        json.dump(raw, f)
+    with pytest.raises(plandb.PlanDBError):
+        plandb.load_db(path)
+
+
+def test_v0_flat_layout_migrates(tmp_path):
+    # the pre-schema prototype: a flat {config-key: choice-json} mapping
+    path = str(tmp_path / "plans.json")
+    cfg = _config()
+    with open(path, "w") as f:
+        json.dump({cfg.key(): _choice().to_json()}, f)
+    db = plandb.load_db(path)
+    assert db["v"] == plandb.DB_VERSION
+    entry = plandb.lookup(db, cfg)
+    assert entry is not None and entry["source"] == "legacy"
+    assert PlanChoice.from_json(entry["choice"]) == _choice()
+    # migrated DBs re-save as v1 and reload cleanly
+    plandb.save_db(path, db)
+    assert plandb.load_db(path)["v"] == plandb.DB_VERSION
+
+
+def test_v0_garbage_rejected(tmp_path):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        json.dump({"some": "junk"}, f)
+    with pytest.raises(plandb.PlanDBError):
+        plandb.load_db(path)
+
+
+def test_save_refuses_invalid():
+    with pytest.raises(plandb.PlanDBError, match="refusing"):
+        plandb.save_db("/tmp/never-written.json",
+                       {"v": 1, "kind": "nope", "entries": {}})
+
+
+def test_prune_filters_and_guard(tmp_path):
+    db = plandb.empty_db()
+    plandb.record(db, plandb.make_entry(_config(q=1), _choice(), "seed"))
+    plandb.record(db, plandb.make_entry(_config(q=2), _choice(), "probe"))
+    plandb.record(db, plandb.make_entry(
+        _config(q=2, platform="tpu"), _choice(), "probe"))
+    with pytest.raises(ValueError, match="filter"):
+        plandb.prune_db(db)
+    assert plandb.prune_db(db, source="seed") == 1
+    assert plandb.prune_db(db, platform="tpu") == 1
+    assert len(db["entries"]) == 1
+    assert plandb.prune_db(db, older_than_s=3600.0) == 0  # all fresh
