@@ -119,34 +119,48 @@ def _child_main(mode: str, resume: bool = False) -> int:
     chunk = 360 if on_accel else 3
 
     from stencil_tpu.apps.jacobi3d import run
+    from stencil_tpu.fault import FAULT_RC, RecoveryExhausted
     from stencil_tpu.utils.statistics import Statistics
     from stencil_tpu.utils.sync import hard_sync
 
     # headline jacobi: REQUIRED — if this dies the child fails and the
     # parent falls back. With a checkpoint dir, the leg is durable per
-    # chunk and a revived child (--resume) continues mid-campaign.
+    # chunk and a revived child (--resume) continues mid-campaign. The
+    # health guard checks the field once per fused chunk: an in-band NaN
+    # burst (a bad device, a corrupted payload) rolls back to the last
+    # durable chunk instead of poisoning the headline number, and a run
+    # that cannot recover exits the DISTINCT fault rc so the parent's
+    # ladder reports "numerics broken", not a generic crash.
     ckpt_dir = os.environ.get("STENCIL_BENCH_CKPT_DIR") or None
     if ckpt_dir:
         # per-config subdir: the 128^3 CPU fallback must never repoint
         # LATEST or prune away the 512^3 accel campaign's snapshots
         ckpt_dir = os.path.join(ckpt_dir, f"jacobi{n}")
     leg("jacobi3d headline")
-    r = run(n, n, n, iters=3 * chunk, weak=False, devices=jax.devices()[:1],
-            warmup=1, chunk=chunk,
-            ckpt_dir=ckpt_dir, ckpt_every=chunk if ckpt_dir else 0,
-            resume=resume and ckpt_dir is not None)
-    import math
-
-    if ckpt_dir and not math.isfinite(r["iter_trimean_s"]):
-        # the previous child finished this leg (snapshot at step==iters)
-        # but died before delivering the sentinel, so its timings are
-        # gone: a resume has nothing to time and would report a 0.0
-        # headline — re-measure fresh instead
-        print(f"[bench:{mode}] resume found the jacobi leg complete; "
-              "re-measuring", file=sys.stderr, flush=True)
+    try:
         r = run(n, n, n, iters=3 * chunk, weak=False,
-                devices=jax.devices()[:1], warmup=1, chunk=chunk,
-                ckpt_dir=ckpt_dir, ckpt_every=chunk, resume=False)
+                devices=jax.devices()[:1],
+                warmup=1, chunk=chunk,
+                ckpt_dir=ckpt_dir, ckpt_every=chunk if ckpt_dir else 0,
+                resume=resume and ckpt_dir is not None,
+                health_every=chunk)
+        import math
+
+        if ckpt_dir and not math.isfinite(r["iter_trimean_s"]):
+            # the previous child finished this leg (snapshot at step==iters)
+            # but died before delivering the sentinel, so its timings are
+            # gone: a resume has nothing to time and would report a 0.0
+            # headline — re-measure fresh instead
+            print(f"[bench:{mode}] resume found the jacobi leg complete; "
+                  "re-measuring", file=sys.stderr, flush=True)
+            r = run(n, n, n, iters=3 * chunk, weak=False,
+                    devices=jax.devices()[:1], warmup=1, chunk=chunk,
+                    ckpt_dir=ckpt_dir, ckpt_every=chunk, resume=False,
+                    health_every=chunk)
+    except RecoveryExhausted as e:
+        print(f"[bench:{mode}] headline leg faulted beyond recovery: {e}",
+              file=sys.stderr, flush=True)
+        return FAULT_RC
     mcells = r["mcells_per_s_per_dev"]
 
     # exchange benchmark: radius-3, 4 float quantities (exchange_weak config,

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""CI fault-tolerance gate: inject -> detect -> roll back -> bit-identical.
+
+The executable acceptance proof of the fault/ self-healing layer on the
+8-virtual-device CPU mesh (no TPU needed), jacobi3d 24^3, 6 iterations,
+checkpoint + health cadence of 2:
+
+1. reference: a clean run (guard ON — also proves no false positives)
+   writes its final-state snapshot;
+2. detect + rollback: ``--inject nan@3`` bursts NaN into one block at
+   step 3; the guard must detect within ``--health-every`` steps
+   (metrics pin: health.fault step - fault.injected step <= 2), roll
+   back to the step-2 snapshot, complete with rc 0, and the final field
+   must be bit-identical to the reference (``ckpt_tool diff --data``);
+3. newest-corrupt fallback: ``ckpt-truncate@5`` truncates the newest
+   (step-4) snapshot before the ``nan@5`` fault; the rollback must skip
+   it to the prior good step-2 snapshot (metrics pin:
+   recover.rollback to_step == 2) and still finish bit-identical;
+4. quarantine: a hand-truncated snapshot fails ``ckpt_tool validate``,
+   ``validate --all --quarantine`` renames it aside, and a re-validate
+   of the remaining snapshots passes — auto-resume stops rescanning it;
+5. exhaustion: ``nan@3:repeat=always`` with ``--max-rollbacks 2`` must
+   abort with the DISTINCT fault rc (43) under the watchdog, which
+   classifies the outcome as ``fault`` (not crash/stall), archives the
+   child's metrics JSONL as evidence, and leaves a fault-evidence.json
+   bundle in the checkpoint dir;
+6. schema: every produced metrics file passes ``report --validate``
+   (the telemetry gate extended to the fault.*/health.*/recover.*
+   vocabulary) and carries health.check spans (the guard's measured
+   per-check overhead).
+
+Exit code 0 only if every stage holds. Run from the repo root:
+
+  python scripts/ci_fault_gate.py [--size 24] [--iters 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def run(cmd, env=None, expect_rc=0, name=""):
+    print(f"[fault-gate] {name}: {' '.join(cmd)}", flush=True)
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    p = subprocess.run(cmd, env=e, cwd=REPO, capture_output=True, text=True)
+    if p.returncode != expect_rc:
+        print(p.stdout)
+        print(p.stderr, file=sys.stderr)
+        raise SystemExit(
+            f"[fault-gate] {name}: rc={p.returncode}, expected {expect_rc}"
+        )
+    return p
+
+
+def jacobi(args, extra, env=None, expect_rc=0, name=""):
+    cmd = [
+        PY, "-m", "stencil_tpu.apps.jacobi3d", "--cpu", "8",
+        "--x", str(args.size), "--y", str(args.size), "--z", str(args.size),
+        "--iters", str(args.iters), "--ckpt-every", "2", "--health-every",
+        "2", "--rollback-backoff", "0.05",
+    ] + extra
+    return run(cmd, env=env, expect_rc=expect_rc, name=name)
+
+
+def records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def named(recs, name):
+    return [r for r in recs if r.get("name") == name]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=24)
+    p.add_argument("--iters", type=int, default=6)
+    args = p.parse_args()
+
+    work = tempfile.mkdtemp(prefix="fault-gate-")
+    ref = os.path.join(work, "ref")
+    metrics = []
+    try:
+        # 1. clean reference, guard ON: no false positives, rc 0
+        jacobi(args, ["--ckpt-dir", ref], name="reference")
+
+        # 2. inject -> detect within --health-every -> roll back -> finish
+        ck = os.path.join(work, "ck")
+        m1 = os.path.join(work, "m1.jsonl")
+        metrics.append(m1)
+        jacobi(args, ["--ckpt-dir", ck, "--inject", "nan@3",
+                      "--metrics-out", m1], name="nan-rollback")
+        recs = records(m1)
+        inj = named(recs, "fault.injected")
+        flt = named(recs, "health.fault")
+        rb = named(recs, "recover.rollback")
+        if not (inj and flt and rb):
+            raise SystemExit("[fault-gate] metrics lack fault.injected/"
+                             "health.fault/recover.rollback records")
+        if flt[0]["step"] - inj[0]["step"] > 2:
+            raise SystemExit(
+                f"[fault-gate] detection at step {flt[0]['step']} is more "
+                f"than --health-every after injection at {inj[0]['step']}")
+        if not named(recs, "health.check"):
+            raise SystemExit("[fault-gate] no health.check spans recorded")
+        run([PY, "-m", "stencil_tpu.apps.ckpt_tool", "diff", ref, ck,
+             "--data"], name="diff-rollback")
+
+        # 3. newest snapshot corrupted -> fall back to the prior good one
+        ck2 = os.path.join(work, "ck2")
+        m2 = os.path.join(work, "m2.jsonl")
+        metrics.append(m2)
+        jacobi(args, ["--ckpt-dir", ck2, "--inject", "ckpt-truncate@5,nan@5",
+                      "--metrics-out", m2], name="corrupt-fallback")
+        rb2 = named(records(m2), "recover.rollback")
+        if not rb2 or rb2[0]["to_step"] != 2:
+            raise SystemExit(f"[fault-gate] fallback rolled to "
+                             f"{rb2 and rb2[0]['to_step']}, expected the "
+                             "prior good snapshot at step 2")
+        run([PY, "-m", "stencil_tpu.apps.ckpt_tool", "diff", ref, ck2,
+             "--data"], name="diff-fallback")
+
+        # 4. quarantine: a truncated snapshot is renamed aside and stays out
+        # of every later scan (the run above left ck2's step-4 truncated
+        # only transiently — it was re-saved clean — so truncate one here)
+        sys.path.insert(0, REPO)
+        from stencil_tpu.ckpt import find_resume, list_snapshots
+
+        victim = os.path.join(ck2, list_snapshots(ck2)[0], "block_0_0_0.npz")
+        with open(victim, "r+b") as f:
+            f.truncate(16)
+        run([PY, "-m", "stencil_tpu.apps.ckpt_tool", "validate", ck2,
+             "--all"], expect_rc=1, name="validate-corrupt")
+        q = run([PY, "-m", "stencil_tpu.apps.ckpt_tool", "validate", ck2,
+                 "--all", "--quarantine"], expect_rc=1, name="quarantine")
+        if "quarantined ->" not in q.stdout:
+            raise SystemExit("[fault-gate] --quarantine did not rename the "
+                             "invalid snapshot")
+        run([PY, "-m", "stencil_tpu.apps.ckpt_tool", "validate", ck2,
+             "--all"], name="validate-post-quarantine")
+        found = find_resume(ck2)
+        if found is None or "quarantine" in found[0]:
+            raise SystemExit("[fault-gate] find_resume still sees the "
+                             "quarantined snapshot")
+
+        # 5. exhaustion under the watchdog: distinct rc, fault outcome,
+        # archived metrics evidence, evidence bundle on disk
+        from stencil_tpu.obs import watchdog
+
+        ck3 = os.path.join(work, "ck3")
+        m3 = os.path.join(work, "m3.jsonl")
+        metrics.append(m3)
+        env = dict(os.environ)
+        env["STENCIL_METRICS_OUT"] = m3
+        cmd = [
+            PY, "-m", "stencil_tpu.apps.jacobi3d", "--cpu", "8",
+            "--x", str(args.size), "--y", str(args.size),
+            "--z", str(args.size), "--iters", str(args.iters),
+            "--ckpt-every", "2", "--health-every", "2",
+            "--rollback-backoff", "0.05", "--ckpt-dir", ck3,
+            "--max-rollbacks", "2", "--inject", "nan@3:repeat=always",
+            "--metrics-out", m3,
+        ]
+        print(f"[fault-gate] exhaustion: {' '.join(cmd)}", flush=True)
+        att = watchdog.supervise(
+            cmd, timeout_s=600, env=env, name="exhaustion", cwd=REPO,
+            archive_dir=os.path.join(work, "logs"),
+        )
+        if att.outcome != watchdog.FAULT or att.rc != watchdog.FAULT_RC:
+            raise SystemExit(
+                f"[fault-gate] exhaustion outcome={att.outcome} rc={att.rc}, "
+                f"expected {watchdog.FAULT}/{watchdog.FAULT_RC}")
+        if not (att.metrics_log_path and os.path.isfile(att.metrics_log_path)):
+            raise SystemExit("[fault-gate] watchdog did not archive the "
+                             "metrics JSONL evidence")
+        evidence = os.path.join(ck3, "fault-evidence.json")
+        with open(evidence) as f:
+            ev = json.load(f)
+        if sum(ev["rollbacks"].values()) <= 2 or "max rollbacks" not in ev["reason"]:
+            raise SystemExit(f"[fault-gate] unexpected evidence bundle: {ev}")
+        ab = named(records(m3), "recover.aborted")
+        if not ab:
+            raise SystemExit("[fault-gate] metrics lack recover.aborted")
+
+        # 6. every metrics file passes the (extended) telemetry schema gate
+        run([PY, "-m", "stencil_tpu.apps.report"] + metrics + ["--validate"],
+            name="report-validate")
+
+        print("[fault-gate] PASS")
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -458,9 +458,20 @@ class DistributedDomain:
         if jax.process_count() > 1:
             # cross-host shards are not addressable from this process;
             # per-host sharded writes + manifest merge are a ROADMAP #7
-            # follow-up — degrade loudly, never kill the campaign
-            log.warn("ckpt: multi-process checkpoint writes are not "
-                     "supported yet; skipping save")
+            # follow-up — degrade loudly ONCE, and count every skip so a
+            # campaign with zero durable state is alertable from its
+            # metrics (ckpt.save_skipped), never kill the run
+            from .obs import telemetry
+
+            telemetry.get().counter(
+                "ckpt.save_skipped", value=1, phase="ckpt", step=int(step),
+                reason="multi-process writes unsupported")
+            if not getattr(self, "_ckpt_skip_warned", False):
+                self._ckpt_skip_warned = True
+                log.warn("ckpt: multi-process checkpoint writes are not "
+                         "supported yet; skipping save (every skip is "
+                         "counted as ckpt.save_skipped; this warning is "
+                         "not repeated)")
             return
         arrays = {name: self._curr[i] for i, name in enumerate(self._names)}
         dtypes = dict(zip(self._names, self._dtypes))
@@ -482,6 +493,16 @@ class DistributedDomain:
         cp.keep = keep
         cp.save(self.spec, arrays, step, extra_meta=extra_meta)
 
+    def flush_checkpoints(self) -> None:
+        """Block until the in-flight async snapshot (if any) is durable,
+        keeping the writer alive — what the fault/recovery engine calls
+        before reading the checkpoint dir back (rollback restore, the
+        ckpt-truncate injection): disk must reflect every save already
+        handed off."""
+        cp = getattr(self, "_checkpointer", None)
+        if cp is not None:
+            cp.flush()
+
     def finish_checkpoints(self) -> None:
         """Drain the async checkpoint writer (every handed-off snapshot is
         durable when this returns)."""
@@ -502,6 +523,9 @@ class DistributedDomain:
 
         assert self._realized, "restore_checkpoint requires realize()"
         if jax.process_count() > 1:
+            telemetry.get().counter(
+                "ckpt.restore_skipped", value=1, phase="ckpt",
+                reason="multi-process restore unsupported")
             log.warn("ckpt: multi-process restore is not supported yet; "
                      "starting fresh")
             return None
@@ -538,6 +562,31 @@ class DistributedDomain:
         rec.meta("ckpt.resumed", step=manifest["step"], snapshot=snap)
         log.info(f"ckpt: restored step {manifest['step']} from {snap}")
         return manifest["step"]
+
+    # -- numerical health (fault/ subsystem) ---------------------------------
+    def check_health(self, max_abs: Optional[float] = None,
+                     step: Optional[int] = None) -> None:
+        """One fused ``isfinite`` reduction (plus an optional ``max|u|``
+        divergence ceiling) over every quantity's current state — raises
+        :class:`stencil_tpu.fault.NumericalFault` naming the offending
+        quantity and records the per-check cost as a ``health.check``
+        span. The step program is untouched (the guard is a separate
+        compiled reduction): with no check calls there is zero HLO
+        change. The loop-integrated version (periodic checks + rollback)
+        is :func:`stencil_tpu.fault.run_guarded`, wired as the apps'
+        ``--health-every`` / ``--max-rollbacks`` knobs."""
+        from .fault.health import HealthGuard
+
+        assert self._realized, "check_health requires realize()"
+        g = getattr(self, "_health_guard", None)
+        if g is None:
+            g = self._health_guard = HealthGuard(every=1, max_abs=max_abs)
+        # the ceiling is a host-side comparison, not part of the compiled
+        # reduction — mutate it rather than rebuilding (and re-jitting) the
+        # guard when callers alternate ceilings
+        g.max_abs = float(max_abs) if max_abs else None
+        g.check({self._names[i]: a for i, a in self._curr.items()},
+                step=-1 if step is None else int(step))
 
     # -- observability -------------------------------------------------------
     def write_plan(self, prefix: str) -> None:

@@ -83,6 +83,11 @@ def run(
     batch_quantities: bool = True,
     autotune: bool = False,
     plan_db: Optional[str] = None,
+    health_every: int = 0,
+    max_abs: Optional[float] = None,
+    max_rollbacks: int = 3,
+    rollback_backoff: float = 0.25,
+    inject: Optional[str] = None,
 ) -> dict:
     """Run ``iters`` iterations (plus one untimed warmup chunk) and return
     timing stats + the domain.
@@ -263,29 +268,116 @@ def run(
         curr = exch_loop(curr)
         hard_sync(curr)
 
+        # Self-healing (fault/): when a health guard or injection schedule
+        # is configured, the 8-field loop runs under the same guarded
+        # engine as jacobi3d (step -> inject -> check -> checkpoint, with
+        # rollback-with-backoff on a NumericalFault); otherwise the
+        # historical fixed-chunk loop runs untouched — identical compiled
+        # programs either way.
+        from ..fault import (FaultPlan, HealthGuard, RecoveryPolicy,
+                             chunk_plan, run_guarded)
+
+        guard = (HealthGuard(every=health_every, max_abs=max_abs)
+                 if health_every > 0 else None)
+        injector = FaultPlan.from_spec(inject)
         done = start
-        next_ckpt = (start // ckpt_every + 1) * ckpt_every if (
-            ckpt_dir and ckpt_every > 0) else None
-        while done < iters:
-            t0 = time.perf_counter()
-            curr, nxt = step(curr, nxt)
-            hard_sync(curr)
-            per = (time.perf_counter() - t0) / chunk
-            for _ in range(chunk):
-                iter_time.insert(per)
-            rec.emit("span", "astaroth.iter", phase="step", seconds=per,
-                     iters=chunk)
-            done += chunk
-            if next_ckpt is not None and done >= next_ckpt and done < iters:
-                save_ckpt(done, curr)
-                next_ckpt = (done // ckpt_every + 1) * ckpt_every
-            t0 = time.perf_counter()
-            curr = exch_loop(curr)
-            hard_sync(curr)
-            ex_dt = time.perf_counter() - t0
-            exch_time.insert(ex_dt)
-            rec.emit("span", "astaroth.exchange", phase="exchange",
-                     seconds=ex_dt, iters=n_ex)
+        if guard is not None or injector is not None:
+            steps_cache = {chunk: step}
+
+            def get_step(k: int):
+                # fault-mode chunk plans may carry tail sizes the fixed
+                # benchmark chunking never needed; compile them on demand
+                if k not in steps_cache:
+                    steps_cache[k] = make_astaroth_step(
+                        dd.halo_exchange, info, dt=dt, overlap=overlap,
+                        swap_per_substep=swap_per_substep,
+                        use_pallas=use_pallas, dtype=dtype, iters=k,
+                        kernel_variant=kernel_variant,
+                    )
+                return steps_cache[k]
+
+            def plan_fn(s: int):
+                return chunk_plan(
+                    s, iters, chunk,
+                    every=(ckpt_every if (ckpt_dir and ckpt_every > 0) else 0,
+                           health_every if guard is not None else 0),
+                    at=injector.steps() if injector is not None else (),
+                )
+
+            def step_fn(st, k):
+                nonlocal nxt
+                c, n2 = get_step(k)(st, nxt)
+                hard_sync(c)
+                nxt = n2
+                return c
+
+            def on_chunk(st, k, per, done_now):
+                for _ in range(k):
+                    iter_time.insert(per)
+                rec.emit("span", "astaroth.iter", phase="step", seconds=per,
+                         iters=k)
+                t1 = time.perf_counter()
+                st = exch_loop(st)
+                hard_sync(st)
+                ex_dt = time.perf_counter() - t1
+                exch_time.insert(ex_dt)
+                rec.emit("span", "astaroth.exchange", phase="exchange",
+                         seconds=ex_dt, iters=n_ex)
+                return st
+
+            save_fn = restore_fn = quarantine_fn = flush_fn = None
+            if ckpt_dir:
+                if ckpt_every > 0:
+                    save_fn = save_ckpt
+                flush_fn = dd.flush_checkpoints
+
+                def restore_fn():
+                    s = dd.restore_checkpoint(ckpt_dir)
+                    if s is None:
+                        return None
+                    return s, {name: dd.get_curr(handles[name])
+                               for name in FIELDS}
+
+                def quarantine_fn(s):
+                    from ..ckpt import quarantine_snapshot, snapshot_name
+
+                    quarantine_snapshot(
+                        ckpt_dir, snapshot_name(s),
+                        reason="restored state failed health check")
+
+            curr, done = run_guarded(
+                curr, start=start, iters=iters, plan_fn=plan_fn,
+                step_fn=step_fn, guard=guard, injector=injector,
+                policy=RecoveryPolicy(max_rollbacks=max_rollbacks,
+                                      backoff_s=rollback_backoff),
+                save_fn=save_fn, ckpt_every=ckpt_every,
+                restore_fn=restore_fn, quarantine_fn=quarantine_fn,
+                flush_fn=flush_fn, on_chunk=on_chunk, spec=dd.spec,
+                ckpt_dir=ckpt_dir, app="astaroth",
+            )
+        else:
+            next_ckpt = (start // ckpt_every + 1) * ckpt_every if (
+                ckpt_dir and ckpt_every > 0) else None
+            while done < iters:
+                t0 = time.perf_counter()
+                curr, nxt = step(curr, nxt)
+                hard_sync(curr)
+                per = (time.perf_counter() - t0) / chunk
+                for _ in range(chunk):
+                    iter_time.insert(per)
+                rec.emit("span", "astaroth.iter", phase="step", seconds=per,
+                         iters=chunk)
+                done += chunk
+                if next_ckpt is not None and done >= next_ckpt and done < iters:
+                    save_ckpt(done, curr)
+                    next_ckpt = (done // ckpt_every + 1) * ckpt_every
+                t0 = time.perf_counter()
+                curr = exch_loop(curr)
+                hard_sync(curr)
+                ex_dt = time.perf_counter() - t0
+                exch_time.insert(ex_dt)
+                rec.emit("span", "astaroth.exchange", phase="exchange",
+                         seconds=ex_dt, iters=n_ex)
         if ckpt_dir:
             if done > start or start == 0:
                 save_ckpt(done, curr)  # the final state is always durable
@@ -416,6 +508,22 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume from the newest valid snapshot under "
                         "--ckpt-dir when one exists (fresh start otherwise)")
+    p.add_argument("--health-every", type=int, default=0,
+                   help="numerical health guard (fault/): one fused "
+                        "isfinite reduction over all 8 fields every N "
+                        "steps; a fault rolls back to the newest valid "
+                        "snapshot (0 = off)")
+    p.add_argument("--max-abs", type=float, default=0.0,
+                   help="with --health-every, divergence ceiling on any "
+                        "field's max|u| (0 = no ceiling)")
+    p.add_argument("--max-rollbacks", type=int, default=3,
+                   help="rollbacks allowed per faulting step before the "
+                        "run aborts with rc 43 + an evidence bundle")
+    p.add_argument("--rollback-backoff", type=float, default=0.25,
+                   help="first-retry backoff seconds (doubles per repeat)")
+    p.add_argument("--inject", type=str, default="",
+                   help="deterministic fault injection spec (see "
+                        "fault/inject.py; default: STENCIL_FAULT_INJECT)")
     p.add_argument("--autotune", action="store_true",
                    help="choose the exchange plan (partition x method x "
                         "quantity batching) via the plan/ autotuner; a plan-"
@@ -440,30 +548,44 @@ def main(argv: Optional[list] = None) -> int:
         jax.config.update("jax_enable_x64", True)
     elif not args.f32 and not args.f64:
         log.info("TPU platform: defaulting to float32 fields (use --f64 to force)")
-    r = run(
-        iters=args.iters,
-        conf=args.conf,
-        trivial=args.trivial,
-        random_=args.random,
-        no_compute=args.no_compute,
-        overlap=not args.no_overlap,
-        dtype="float64" if use_f64 else "float32",
-        nx=args.nx,
-        paraview_init=args.paraview_init,
-        paraview_final=args.paraview_final,
-        reductions=args.reductions,
-        use_pallas=False if args.no_pallas else None,
-        chunk=args.chunk,
-        kernel_variant=args.kernel_variant,
-        metrics_dma=args.metrics_dma and rec.enabled,
-        ckpt_dir=args.ckpt_dir or None,
-        ckpt_every=args.ckpt_every,
-        ckpt_keep=args.ckpt_keep,
-        resume=args.resume,
-        batch_quantities=not args.per_quantity_exchange,
-        autotune=args.autotune,
-        plan_db=args.plan_db or None,
-    )
+    from ..fault import FAULT_RC, RecoveryExhausted
+
+    try:
+        r = run(
+            iters=args.iters,
+            conf=args.conf,
+            trivial=args.trivial,
+            random_=args.random,
+            no_compute=args.no_compute,
+            overlap=not args.no_overlap,
+            dtype="float64" if use_f64 else "float32",
+            nx=args.nx,
+            paraview_init=args.paraview_init,
+            paraview_final=args.paraview_final,
+            reductions=args.reductions,
+            use_pallas=False if args.no_pallas else None,
+            chunk=args.chunk,
+            kernel_variant=args.kernel_variant,
+            metrics_dma=args.metrics_dma and rec.enabled,
+            ckpt_dir=args.ckpt_dir or None,
+            ckpt_every=args.ckpt_every,
+            ckpt_keep=args.ckpt_keep,
+            resume=args.resume,
+            batch_quantities=not args.per_quantity_exchange,
+            autotune=args.autotune,
+            plan_db=args.plan_db or None,
+            health_every=args.health_every,
+            max_abs=args.max_abs or None,
+            max_rollbacks=args.max_rollbacks,
+            rollback_backoff=args.rollback_backoff,
+            inject=args.inject or None,
+        )
+    except RecoveryExhausted as e:
+        log.error(f"astaroth: {e}")
+        if rec.enabled:
+            rec.record_timer_buckets()
+            rec.close()
+        return FAULT_RC
     print(csv_row(r))
     log.info(timer.report())
     if rec.enabled:

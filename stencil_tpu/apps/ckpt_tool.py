@@ -9,7 +9,10 @@ CI integrity gate:
 - ``validate PATH``  full integrity check (manifest schema, payload byte
                      counts + SHA-256, block coverage); ``--all`` checks
                      every snapshot under a checkpoint dir. Exit 1 on any
-                     problem — this is the CI gate.
+                     problem — this is the CI gate. ``--quarantine``
+                     renames invalid snapshots aside (``quarantine-*``)
+                     so auto-resume stops rescanning them on every
+                     restart.
 - ``diff A B``       compare two snapshots' metadata; ``--data``
                      additionally reassembles every quantity's global
                      interior from both and requires bit-equality (the
@@ -97,6 +100,14 @@ def cmd_validate(args) -> int:
             print(f"INVALID {snap}")
             for e in errs:
                 print(f"  - {e}")
+            if args.quarantine:
+                from ..ckpt import quarantine_snapshot
+
+                ckpt_dir, name = os.path.split(os.path.normpath(snap))
+                dest = quarantine_snapshot(ckpt_dir or ".", name,
+                                           reason=errs[0])
+                if dest:
+                    print(f"  quarantined -> {os.path.basename(dest)}")
         else:
             print(f"ok {snap}")
     if args.all:
@@ -170,6 +181,10 @@ def main(argv: Optional[list] = None) -> int:
                     help="validate every snapshot under a checkpoint dir")
     pv.add_argument("--shallow", action="store_true",
                     help="skip SHA-256 (byte counts + coverage only)")
+    pv.add_argument("--quarantine", action="store_true",
+                    help="rename invalid snapshots aside (quarantine-*) so "
+                         "auto-resume stops rescanning them on every "
+                         "restart; the bytes stay on disk as evidence")
     pv.set_defaults(fn=cmd_validate)
     pd = sub.add_parser("diff", help="compare two snapshots")
     pd.add_argument("a")

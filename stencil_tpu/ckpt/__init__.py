@@ -23,10 +23,12 @@ from .snapshot import (  # noqa: F401
     write_snapshot,
 )
 from .restore import (  # noqa: F401
+    QUARANTINE_PREFIX,
     assemble_global,
     check_compatible,
     find_resume,
     load_manifest,
+    quarantine_snapshot,
     validate_manifest,
     validate_snapshot,
 )

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -35,10 +36,15 @@ from .snapshot import (
     MANIFEST_NAME,
     MANIFEST_VERSION,
     _sha256,
+    _write_latest,
     list_snapshots,
     read_latest,
     step_of,
 )
+
+#: Prefix of quarantined snapshot dirs — ``list_snapshots``/``find_resume``
+#: never look at them again (``step_of`` only parses ``step-`` names).
+QUARANTINE_PREFIX = "quarantine-"
 
 
 def load_manifest(snapshot_dir: str) -> dict:
@@ -170,6 +176,54 @@ def find_resume(
                 continue
         return snap, manifest
     return None
+
+
+def quarantine_snapshot(ckpt_dir: str, name: str,
+                        reason: str = "") -> Optional[str]:
+    """Rename an invalid/poisoned snapshot aside (``quarantine-<name>-…``)
+    so :func:`find_resume` stops re-validating — and re-warning about —
+    it on every restart, while the bytes stay on disk as post-mortem
+    evidence. If ``LATEST`` named the quarantined snapshot, the pointer
+    is repointed at the newest remaining snapshot (or removed when none
+    is left — ``LATEST`` must never dangle *because of us*).
+
+    Returns the quarantine directory, or None when ``name`` does not
+    exist under ``ckpt_dir``.
+    """
+    src = os.path.join(ckpt_dir, name)
+    if not os.path.isdir(src):
+        return None
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    dest = os.path.join(ckpt_dir, f"{QUARANTINE_PREFIX}{name}-{stamp}")
+    n = 0
+    while os.path.exists(dest):  # same-second double quarantine
+        n += 1
+        dest = os.path.join(
+            ckpt_dir, f"{QUARANTINE_PREFIX}{name}-{stamp}-{n}")
+    os.rename(src, dest)
+    try:  # best-effort breadcrumb for the post-mortem reader
+        with open(os.path.join(dest, "QUARANTINED.txt"), "w") as f:
+            f.write(f"quarantined {time.strftime('%Y-%m-%dT%H:%M:%S')}: "
+                    f"{reason or 'failed validation'}\n")
+    except OSError:
+        pass
+    if read_latest(ckpt_dir) == name:
+        remaining = list_snapshots(ckpt_dir)
+        if remaining:
+            _write_latest(ckpt_dir, remaining[-1])
+        else:
+            try:
+                os.remove(os.path.join(ckpt_dir, LATEST_NAME))
+            except OSError:
+                pass
+    log.warn(f"ckpt: quarantined snapshot {name} -> "
+             f"{os.path.basename(dest)}"
+             + (f" ({reason})" if reason else ""))
+    from ..obs import telemetry  # lazy: keep ckpt_tool's import graph lean
+
+    telemetry.get().counter("ckpt.quarantined", value=1, phase="ckpt",
+                            snapshot=name, reason=reason or None)
+    return dest
 
 
 def assemble_global(

@@ -1,0 +1,38 @@
+"""Self-healing runs: detect, inject, recover.
+
+The in-band fault-tolerance layer between PR 3's stall watchdog (outer,
+process-level) and PR 4's crash-safe checkpoints (durable state):
+
+- :mod:`health`  — the numerical health guard: one fused jitted
+  isfinite/max reduction over the state every ``--health-every`` steps,
+  raising a typed :class:`NumericalFault`; zero HLO change when off.
+- :mod:`inject`  — deterministic, seeded fault injection (NaN/Inf burst,
+  halo-payload corruption, checkpoint truncation, stall, crash, slow
+  phase), every firing recorded as a ``fault.injected`` telemetry record.
+- :mod:`recover` — the rollback-with-backoff policy driving a guarded
+  step loop: restore the newest valid snapshot, quarantine poisoned
+  ones, back off exponentially, and after ``--max-rollbacks`` abort with
+  :data:`FAULT_RC` plus a JSON evidence bundle.
+
+The executable acceptance proof is ``scripts/ci_fault_gate.py``.
+"""
+
+from .health import DIVERGENCE, NONFINITE, HealthGuard, NumericalFault  # noqa: F401
+from .inject import (  # noqa: F401
+    ENV_SEED,
+    ENV_SPEC,
+    FaultPlan,
+    Injection,
+    parse_spec,
+    truncate_newest_payload,
+)
+from .recover import (  # noqa: F401
+    EVIDENCE_ENV,
+    EVIDENCE_NAME,
+    FAULT_RC,
+    RecoveryExhausted,
+    RecoveryPolicy,
+    chunk_plan,
+    run_guarded,
+    write_evidence,
+)

@@ -1,0 +1,134 @@
+"""In-loop numerical health guard: one fused isfinite/max reduction.
+
+The reference's failure model is "MPI aborts the job" — it has no defense
+against *in-band* faults: a NaN burst from a bad device, a corrupted halo
+payload, or a diverging field walks straight through the step loop and
+either poisons the final state or (worse) silently poisons the
+checkpoints, so ``--resume`` restores garbage. This module is the
+detection layer of the ``stencil_tpu/fault`` self-healing stack
+(inject.py manufactures the faults; recover.py rolls them back).
+
+Design constraints:
+
+- **One fused dispatch.** The guard compiles a single jitted program that
+  reduces every quantity to ``(all-finite, max|u|)`` pairs — one host
+  round-trip per check, not one per quantity. The per-check wall cost is
+  recorded as a ``health.check`` span so the overhead is measurable in
+  the metrics JSONL, never guessed.
+- **Zero HLO change when disabled.** The guard NEVER wraps or rewrites
+  the step program — it is a *separate* compiled reduction run on the
+  state between fused chunks. A run with the guard off executes the
+  byte-identical step-loop HLO (pinned by tests/test_fault_health.py the
+  way tests/test_overlap_hlo.py pins the overlap structure).
+- **Typed faults.** A failed check raises :class:`NumericalFault` naming
+  the offending quantity, the step, and the fault kind (``nonfinite`` |
+  ``divergence``) — recover.py's rollback policy and the apps' exit
+  codes dispatch on it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import telemetry
+
+#: NumericalFault kinds, in the order the checks run.
+NONFINITE = "nonfinite"
+DIVERGENCE = "divergence"
+
+
+class NumericalFault(RuntimeError):
+    """An in-band numerical fault: non-finite values or a blown ceiling.
+
+    Carries the offending ``quantity`` name, the ``step`` the failed
+    check observed, the fault ``kind``, and (when finite) the observed
+    ``value`` (max |u| of the quantity).
+    """
+
+    def __init__(self, kind: str, quantity: str, step: int,
+                 value: Optional[float] = None):
+        self.kind = kind
+        self.quantity = quantity
+        self.step = int(step)
+        self.value = value
+        what = ("non-finite values" if kind == NONFINITE
+                else f"max|u| = {value:g} over the divergence ceiling")
+        super().__init__(
+            f"numerical fault [{kind}] in quantity {quantity!r} at step "
+            f"{step}: {what}"
+        )
+
+
+class HealthGuard:
+    """Periodic fused health check over a ``{name: stacked array}`` state.
+
+    ``every`` is the check cadence in steps (the loop engine calls
+    :meth:`due` at chunk boundaries); ``max_abs`` adds the optional
+    divergence ceiling on top of the isfinite sweep. One guard instance
+    owns one jitted reduction — jit re-specializes per state
+    shape/dtype structure, so a guard can serve several domains.
+    """
+
+    def __init__(self, every: int = 1, max_abs: Optional[float] = None):
+        self.every = max(1, int(every))
+        self.max_abs = float(max_abs) if max_abs else None
+        self.checks = 0
+        self._reduce = jax.jit(self._build)
+
+    @staticmethod
+    def _build(state):
+        names = sorted(state)
+        finite, amax = [], []
+        for n in names:
+            x = state[n]
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                finite.append(jnp.isfinite(x).all())
+                # f32 is enough for the ceiling verdict: an fp64 magnitude
+                # that overflows the cast reads as inf, which any ceiling
+                # correctly calls divergence
+                amax.append(jnp.max(jnp.abs(x)).astype(jnp.float32))
+            else:  # integer quantities are trivially healthy
+                finite.append(jnp.array(True))
+                amax.append(jnp.array(0.0, jnp.float32))
+        return jnp.stack(finite), jnp.stack(amax)
+
+    def due(self, prev_step: int, step: int) -> bool:
+        """True when a check boundary (a multiple of ``every``) lies in
+        ``(prev_step, step]``."""
+        return step // self.every > prev_step // self.every
+
+    def check(self, state: Dict[str, "jax.Array"], step: int) -> None:
+        """Run the fused reduction; raise :class:`NumericalFault` on the
+        first unhealthy quantity (telemetry gets a ``health.fault`` meta
+        record first — the failed check is evidence either way)."""
+        if not state:
+            return
+        rec = telemetry.get()
+        self.checks += 1
+        with rec.span("health.check", phase="health", step=int(step),
+                      quantities=len(state)):
+            finite, amax = self._reduce(dict(state))
+            finite = np.asarray(jax.device_get(finite))
+            amax = np.asarray(jax.device_get(amax))
+        names = sorted(state)
+        for i, name in enumerate(names):
+            kind = None
+            if not bool(finite[i]):
+                kind = NONFINITE
+            elif self.max_abs is not None and float(amax[i]) > self.max_abs:
+                kind = DIVERGENCE
+            if kind is None:
+                continue
+            value = float(amax[i])
+            rec.meta("health.fault", fault_kind=kind, quantity=name,
+                     step=int(step),
+                     value=value if math.isfinite(value) else None,
+                     ceiling=self.max_abs)
+            raise NumericalFault(
+                kind, name, step,
+                value=value if math.isfinite(value) else None)

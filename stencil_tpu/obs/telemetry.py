@@ -55,6 +55,21 @@ SCHEMA_VERSION = 1
 KINDS = ("span", "counter", "gauge", "meta", "heartbeat")
 REQUIRED_KEYS = ("v", "run", "proc", "kind", "name", "t")
 
+# Name-specific vocabulary (still schema v1): the fault/health/recover
+# records the self-healing layer (stencil_tpu/fault/) emits carry typed
+# payload fields the CI fault gate greps for — validate them here so a
+# renamed or untyped field fails the schema gate, not a post-mortem.
+NAME_FIELDS = {
+    "fault.injected": (("fault_kind", str), ("step", int)),
+    "health.fault": (("fault_kind", str), ("quantity", str), ("step", int)),
+    "health.check": (("step", int),),
+    "recover.fault": (("fault_kind", str), ("step", int)),
+    "recover.rollback": (("from_step", int), ("to_step", int),
+                         ("fault_step", int)),
+    "recover.aborted": (("reason", str), ("step", int)),
+    "ckpt.save_skipped": (("reason", str),),
+}
+
 
 def new_run_id() -> str:
     return time.strftime("%Y%m%dT%H%M%S") + "-" + uuid.uuid4().hex[:8]
@@ -408,6 +423,11 @@ def validate_record(rec) -> List[str]:
             errs.append("heartbeat requires integer 'seq'")
     if "bytes" in rec and not isinstance(rec["bytes"], int):
         errs.append("'bytes' must be an integer where present")
+    for fld, typ in NAME_FIELDS.get(rec["name"], ()):
+        v = rec.get(fld)
+        if not isinstance(v, typ) or (typ is int and isinstance(v, bool)):
+            errs.append(
+                f"{rec['name']} requires {typ.__name__} {fld!r}")
     return errs
 
 

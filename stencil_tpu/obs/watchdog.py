@@ -36,6 +36,7 @@ wedge being supervised lives in JAX backend init.
 from __future__ import annotations
 
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -54,6 +55,14 @@ CRASH = "crash"          # child exited nonzero on its own
 STALL = "stall"          # heartbeat went stale; child was killed
 TIMEOUT = "timeout"      # total budget exhausted; child was killed
 NO_RESULT = "no-result"  # exited 0 but the parser found no payload
+FAULT = "fault"          # child aborted via the fault/recovery ladder
+
+# Contract with stencil_tpu.fault.recover (which imports THIS constant —
+# watchdog.py must stay importable without the package): a child that
+# exhausted its rollback budget exits with this rc, distinct from a stall
+# kill (rc None), a generic crash, and the ckpt kill hook's 17, so the
+# revival ladder can tell "numerics are broken" from "process died".
+FAULT_RC = 43
 
 
 @dataclass
@@ -67,6 +76,7 @@ class Attempt:
     stdout: str
     stderr_tail: str
     log_path: Optional[str] = None  # archived combined log, if archiving
+    metrics_log_path: Optional[str] = None  # archived metrics JSONL (evidence)
 
     def summary(self) -> dict:
         return {
@@ -75,6 +85,7 @@ class Attempt:
             "rc": self.rc,
             "seconds": round(self.seconds, 1),
             "log": self.log_path,
+            "metrics": self.metrics_log_path,
         }
 
 
@@ -110,6 +121,8 @@ def supervise(
     kill_grace_s: float = 5.0,
     cwd: Optional[str] = None,
     stderr_tail_bytes: int = 4000,
+    fault_rc: Optional[int] = FAULT_RC,
+    metrics_path: Optional[str] = None,
 ) -> Attempt:
     """Run ``cmd`` under the layered deadlines and return the Attempt.
 
@@ -119,8 +132,19 @@ def supervise(
     detection (total budget only). ``first_beat_grace_s`` is the deadline
     for the FIRST beat (interpreter + jax import are slow on small
     hosts); it defaults to ``max(heartbeat_timeout_s, 60)``.
+
+    A child exit code equal to ``fault_rc`` is classified as the FAULT
+    outcome (the fault/recovery ladder's rollback-exhausted abort) rather
+    than a generic CRASH. On any non-OK outcome, when archiving is on and
+    the child wrote a metrics JSONL (``metrics_path``, defaulting to the
+    ``STENCIL_METRICS_OUT`` / ``STENCIL_BENCH_METRICS_OUT`` entries of
+    the child's env), the metrics file is archived next to the log — a
+    post-mortem gets telemetry, not just stdout.
     """
     env = dict(env if env is not None else os.environ)
+    if metrics_path is None:
+        metrics_path = (env.get("STENCIL_METRICS_OUT")
+                        or env.get("STENCIL_BENCH_METRICS_OUT"))
     hb_dir = None
     hb_path = None
     if heartbeat_timeout_s is not None:
@@ -143,7 +167,12 @@ def supervise(
             while True:
                 rc = proc.poll()
                 if rc is not None:
-                    outcome = OK if rc == 0 else CRASH
+                    if rc == 0:
+                        outcome = OK
+                    elif fault_rc is not None and rc == fault_rc:
+                        outcome = FAULT
+                    else:
+                        outcome = CRASH
                     break
                 elapsed = time.monotonic() - t0
                 if elapsed > timeout_s:
@@ -200,12 +229,12 @@ def supervise(
         log_path=None,
     )
     if archive_dir:
+        # sub-second suffix: back-to-back retries of one name must not
+        # overwrite each other's archived evidence
+        stamp = (time.strftime("%Y%m%dT%H%M%S")
+                 + f"-{time.time_ns() % 10**6:06d}")
         try:
             os.makedirs(archive_dir, exist_ok=True)
-            # sub-second suffix: back-to-back retries of one name must not
-            # overwrite each other's archived evidence
-            stamp = (time.strftime("%Y%m%dT%H%M%S")
-                     + f"-{time.time_ns() % 10**6:06d}")
             att.log_path = os.path.join(archive_dir, f"{name}-{stamp}.log")
             with open(att.log_path, "w") as f:
                 f.write(f"# attempt={name} outcome={outcome} rc={rc} "
@@ -217,6 +246,19 @@ def supervise(
         except OSError as e:  # archiving must never eat the measurement
             print(f"[watchdog] log archive failed: {e}", file=sys.stderr)
             att.log_path = None
+        # evidence bundle: on a bad outcome, the child's metrics JSONL is
+        # archived beside the log (a copy, not a move — a later resumed
+        # child may still be appending to the live file)
+        if (outcome != OK and metrics_path
+                and os.path.isfile(metrics_path)):
+            try:
+                dest = os.path.join(archive_dir,
+                                    f"{name}-{stamp}.metrics.jsonl")
+                shutil.copyfile(metrics_path, dest)
+                att.metrics_log_path = dest
+            except OSError as e:
+                print(f"[watchdog] metrics archive failed: {e}",
+                      file=sys.stderr)
     return att
 
 

@@ -385,3 +385,38 @@ def test_ckpt_tool_cli(tmp_path, capsys):
     with open(os.path.join(s3, "block_0_0_0.npz"), "r+b") as f:
         f.truncate(10)
     assert tool(["validate", d, "--all"]) == 1
+
+
+def test_quarantine_invalid_snapshot(tmp_path):
+    """ckpt_tool validate --quarantine / quarantine_snapshot: an invalid
+    (truncated) snapshot is renamed aside so find_resume stops rescanning
+    it on every restart; LATEST is repointed at the newest survivor."""
+    from stencil_tpu.apps import ckpt_tool
+    from stencil_tpu.ckpt import QUARANTINE_PREFIX, quarantine_snapshot
+
+    spec = small_spec()
+    d = str(tmp_path)
+    write_snapshot(d, 1, spec, host_state(spec, 1), keep=5)
+    write_snapshot(d, 2, spec, host_state(spec, 2), keep=5)
+    victim = os.path.join(d, snapshot_name(2), "block_0_0_0.npz")
+    with open(victim, "r+b") as f:
+        f.truncate(10)
+    # the CLI path: validate --all --quarantine renames the bad one
+    rc = ckpt_tool.main(["validate", d, "--all", "--quarantine"])
+    assert rc == 1  # the invalid snapshot still fails THIS run
+    assert list_snapshots(d) == [snapshot_name(1)]
+    qdirs = [e for e in os.listdir(d) if e.startswith(QUARANTINE_PREFIX)]
+    assert len(qdirs) == 1 and snapshot_name(2) in qdirs[0]
+    # evidence breadcrumb + LATEST repointed at the survivor
+    assert os.path.isfile(os.path.join(d, qdirs[0], "QUARANTINED.txt"))
+    assert read_latest(d) == snapshot_name(1)
+    # a fresh validate now passes, and resume lands on the survivor
+    assert ckpt_tool.main(["validate", d, "--all"]) == 0
+    snap, manifest = find_resume(d)
+    assert manifest["step"] == 1
+    # quarantining the last snapshot removes the dangling LATEST
+    assert quarantine_snapshot(d, snapshot_name(1), reason="test") is not None
+    assert read_latest(d) is None
+    assert find_resume(d) is None
+    # and a nonexistent name is a no-op
+    assert quarantine_snapshot(d, snapshot_name(9)) is None

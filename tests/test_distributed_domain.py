@@ -201,3 +201,68 @@ def test_uneven_via_api():
     dd.set_curr_global(h, field)
     dd.exchange()
     np.testing.assert_array_equal(dd.get_curr_global(h), field)
+
+
+def test_write_paraview_zero_nans(tmp_path):
+    """The NaN-scrubbing dump path: zero_nans=True writes 0.0 where the
+    field holds NaN (both writers — native and the Python fallback — get
+    the already-scrubbed arrays); zero_nans=False keeps the NaN."""
+    dd = DistributedDomain(4, 4, 4)
+    dd.set_devices(jax.devices()[:1])
+    dd.set_partition((1, 1, 1))
+    h = dd.add_data("q", "float32")
+    dd.realize()
+    g = np.arange(64, dtype=np.float32).reshape(4, 4, 4) + 1.0
+    g[0, 0, 0] = np.nan
+    g[2, 1, 3] = np.nan
+    dd.set_curr_global(h, g)
+
+    def read_values(prefix):
+        vals = {}
+        with open(prefix + "_0.txt") as f:
+            next(f)  # header
+            for line in f:
+                z, y, x, v = line.strip().split(",")
+                vals[(int(z), int(y), int(x))] = float(v)
+        return vals
+
+    dd.write_paraview(str(tmp_path / "scrub"), zero_nans=True)
+    vals = read_values(str(tmp_path / "scrub"))
+    assert vals[(0, 0, 0)] == 0.0
+    assert vals[(2, 1, 3)] == 0.0
+    assert vals[(1, 1, 1)] == g[1, 1, 1]  # untouched cells survive
+    assert all(np.isfinite(v) for v in vals.values())
+
+    dd.write_paraview(str(tmp_path / "raw"), zero_nans=False)
+    raw = read_values(str(tmp_path / "raw"))
+    assert np.isnan(raw[(0, 0, 0)])
+
+
+def test_multiprocess_ckpt_skip_is_observable(tmp_path, monkeypatch):
+    """api.py's multi-process checkpoint skip: every skip emits a
+    ckpt.save_skipped counter (so a campaign with zero durable state is
+    alertable) and the warning is deduplicated to once per domain."""
+    import json as _json
+
+    from stencil_tpu.obs import telemetry as _telemetry
+
+    dd, h = make_domain(size=(8, 8, 8), ndev=1)
+    path = str(tmp_path / "m.jsonl")
+    _telemetry.configure(metrics_out=path, app="test")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    try:
+        dd.save_checkpoint(str(tmp_path / "ck"), 1)
+        dd.save_checkpoint(str(tmp_path / "ck"), 2)
+        assert dd.restore_checkpoint(str(tmp_path / "ck")) is None
+    finally:
+        monkeypatch.setattr(jax, "process_count", lambda: 1)
+        _telemetry.configure(metrics_out=None)
+    assert not os.path.isdir(str(tmp_path / "ck"))  # nothing was written
+    recs = [_json.loads(line) for line in open(path) if line.strip()]
+    for r in recs:
+        assert _telemetry.validate_record(r) == [], r
+    skips = [r for r in recs if r["name"] == "ckpt.save_skipped"]
+    assert [r["step"] for r in skips] == [1, 2]
+    assert all(r["kind"] == "counter" and r["value"] == 1 for r in skips)
+    assert [r["name"] for r in recs].count("ckpt.restore_skipped") == 1
+    assert dd._ckpt_skip_warned  # the dedup flag latched after one warning

@@ -1,0 +1,154 @@
+"""The numerical health guard (stencil_tpu/fault/health.py).
+
+Pins the ISSUE-7 detection contract: one fused reduction over the state
+dict, typed NumericalFault naming the offending quantity/step/kind, the
+health.check span evidence — and the zero-HLO-change guarantee: building
+and running a guard leaves the compiled step-loop program byte-identical
+(the guard is a separate compiled reduction, pinned here the way
+tests/test_overlap_hlo.py pins the overlap structure).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.api import DistributedDomain
+from stencil_tpu.fault import DIVERGENCE, NONFINITE, HealthGuard, NumericalFault
+from stencil_tpu.obs import telemetry
+
+
+def test_clean_state_passes():
+    g = HealthGuard(every=1)
+    g.check({"a": jnp.ones((4, 4)), "b": jnp.zeros((2, 8))}, step=3)
+    assert g.checks == 1
+
+
+def test_nonfinite_detected_with_quantity_and_step():
+    g = HealthGuard(every=1)
+    bad = jnp.ones((4, 4)).at[1, 2].set(jnp.nan)
+    with pytest.raises(NumericalFault) as ei:
+        g.check({"a": jnp.ones((4, 4)), "b": bad}, step=7)
+    f = ei.value
+    assert f.kind == NONFINITE
+    assert f.quantity == "b"
+    assert f.step == 7
+
+
+def test_inf_detected():
+    g = HealthGuard(every=1)
+    with pytest.raises(NumericalFault) as ei:
+        g.check({"a": jnp.full((4,), jnp.inf)}, step=1)
+    assert ei.value.kind == NONFINITE
+
+
+def test_divergence_ceiling():
+    g = HealthGuard(every=1, max_abs=10.0)
+    g.check({"a": jnp.full((4,), 9.5)}, step=1)  # under the ceiling
+    with pytest.raises(NumericalFault) as ei:
+        g.check({"a": jnp.full((4,), -100.0)}, step=2)
+    f = ei.value
+    assert f.kind == DIVERGENCE
+    assert f.value == pytest.approx(100.0)
+
+
+def test_integer_quantities_trivially_healthy():
+    g = HealthGuard(every=1, max_abs=1.0)
+    g.check({"mask": jnp.full((4,), 7, jnp.int32)}, step=1)
+
+
+def test_due_cadence():
+    g = HealthGuard(every=4)
+    assert not g.due(0, 3)
+    assert g.due(3, 4)
+    assert g.due(2, 9)   # crossed 4 and 8
+    assert not g.due(4, 7)
+    assert g.due(7, 8)
+
+
+def test_health_check_span_and_fault_record(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    telemetry.configure(metrics_out=path, app="test")
+    try:
+        g = HealthGuard(every=1)
+        g.check({"a": jnp.ones((4,))}, step=2)
+        with pytest.raises(NumericalFault):
+            g.check({"a": jnp.full((4,), jnp.nan)}, step=4)
+    finally:
+        telemetry.configure(metrics_out=None)
+    recs = [json.loads(line) for line in open(path) if line.strip()]
+    for r in recs:
+        assert telemetry.validate_record(r) == [], r
+    checks = [r for r in recs if r["name"] == "health.check"]
+    assert len(checks) == 2 and all(r["kind"] == "span" for r in checks)
+    assert {r["step"] for r in checks} == {2, 4}
+    faults = [r for r in recs if r["name"] == "health.fault"]
+    assert len(faults) == 1
+    assert faults[0]["fault_kind"] == NONFINITE
+    assert faults[0]["quantity"] == "a"
+    assert faults[0]["step"] == 4
+
+
+def _small_domain():
+    dd = DistributedDomain(8, 8, 8)
+    dd.set_radius(1)
+    dd.set_devices(jax.devices()[:1])
+    dd.set_partition((1, 1, 1))
+    h = dd.add_data("temperature", "float32")
+    dd.realize()
+    return dd, h
+
+
+def test_domain_check_health():
+    dd, h = _small_domain()
+    dd.check_health()  # fresh zeros are healthy
+    bad = dd.get_curr(h).at[0, 0, 0, 2, 2, 2].set(jnp.nan)
+    dd.set_curr(h, bad)
+    with pytest.raises(NumericalFault) as ei:
+        dd.check_health(step=5)
+    assert ei.value.quantity == "temperature"
+    assert ei.value.step == 5
+
+
+def test_domain_check_health_reuses_one_guard():
+    # alternating ceilings must not rebuild (and re-jit) the reduction:
+    # max_abs is a host-side comparison, not part of the compiled program
+    dd, h = _small_domain()
+    dd.set_curr(h, dd.get_curr(h).at[0, 0, 0, 2, 2, 2].set(2.0))
+    dd.check_health()
+    g = dd._health_guard
+    with pytest.raises(NumericalFault) as ei:
+        dd.check_health(max_abs=0.5, step=3)
+    assert ei.value.kind == "divergence"
+    dd.check_health()  # ceiling off again: healthy
+    assert dd._health_guard is g
+
+
+def test_step_loop_hlo_unchanged_by_guard():
+    """The zero-HLO-change pin: lowering the fused jacobi step loop
+    before and after constructing AND running a HealthGuard on the same
+    state yields byte-identical StableHLO — the guard never wraps,
+    rewrites, or recompiles the step program."""
+    from stencil_tpu.ops.jacobi import make_jacobi_loop, sphere_sel
+    from stencil_tpu.parallel.exchange import shard_blocks
+
+    dd, h = _small_domain()
+    sel = shard_blocks(sphere_sel(dd.size), dd.spec, dd.mesh)
+    curr, nxt = dd.get_curr(h), dd.get_next(h)
+    loop = make_jacobi_loop(dd.halo_exchange, 2)
+    before = loop.lower(curr, nxt, sel).as_text()
+    g = HealthGuard(every=1, max_abs=1e6)
+    g.check({"temperature": curr}, step=1)
+    after = loop.lower(curr, nxt, sel).as_text()
+    assert before == after
+    # and the guard's own reduction is a different (separate) program
+    assert "is_finite" in jax.jit(g._build).lower(
+        {"temperature": curr}).as_text()
+
+
+def test_numpy_state_accepted():
+    g = HealthGuard(every=1)
+    with pytest.raises(NumericalFault):
+        g.check({"q": np.array([1.0, np.nan], np.float32)}, step=0)

@@ -1,0 +1,198 @@
+"""The deterministic fault-injection registry (stencil_tpu/fault/inject.py).
+
+Spec grammar, once-vs-repeat firing semantics, seed-deterministic
+placement (including the same-cells-on-refire rule the rollback paths
+depend on), the halo/boundary-slab geometry, checkpoint truncation, and
+the fault.injected telemetry evidence."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.fault import FaultPlan, parse_spec, truncate_newest_payload
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.obs import telemetry
+
+
+# -- spec grammar -------------------------------------------------------------
+
+
+def test_parse_single_and_defaults():
+    (inj,) = parse_spec("nan@3")
+    assert inj.kind == "nan" and inj.step == 3
+    assert inj.repeat == 1 and inj.fired == 0
+
+
+def test_parse_multi_with_options():
+    injs = parse_spec("nan@3:q=uux:cells=4, crash@5:rc=9; slow@2:seconds=0.5")
+    assert [i.kind for i in injs] == ["nan", "crash", "slow"]
+    assert injs[0].quantity == "uux" and injs[0].cells == 4
+    assert injs[1].rc == 9
+    assert injs[2].seconds == 0.5
+
+
+def test_parse_repeat():
+    assert parse_spec("nan@1:repeat=3")[0].repeat == 3
+    assert parse_spec("nan@1:repeat=always")[0].repeat == -1
+
+
+@pytest.mark.parametrize("bad", ["nan", "nan@x", "frob@3", "nan@3:wat=1",
+                                 "nan@3 cells=2", "nan@0", "crash@0:rc=9"])
+def test_parse_errors_are_loud(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_from_spec_env_fallback(monkeypatch):
+    monkeypatch.delenv("STENCIL_FAULT_INJECT", raising=False)
+    assert FaultPlan.from_spec(None) is None
+    monkeypatch.setenv("STENCIL_FAULT_INJECT", "inf@2")
+    plan = FaultPlan.from_spec(None)
+    assert plan is not None and plan.injections[0].kind == "inf"
+    # explicit spec wins over env
+    plan = FaultPlan.from_spec("nan@9")
+    assert plan.injections[0].kind == "nan"
+    monkeypatch.setenv("STENCIL_FAULT_SEED", "7")
+    assert FaultPlan.from_spec("nan@1").seed == 7
+
+
+def test_steps_and_due():
+    plan = FaultPlan(parse_spec("nan@3,crash@7,nan@3:repeat=2"))
+    assert plan.steps() == [3, 7]
+    inj = plan.injections[0]
+    assert not inj.due(3, 5)     # step 3 not in (3, 5]
+    assert inj.due(2, 3)
+    inj.fired = 1
+    assert not inj.due(2, 3)     # fire-once consumed
+    rep = plan.injections[2]
+    rep.fired = 1
+    assert rep.due(2, 4)         # repeat=2 still has one firing left
+
+
+# -- state corruption ---------------------------------------------------------
+
+
+def _spec():
+    return GridSpec(Dim3(12, 12, 12), Dim3(2, 1, 1), Radius.constant(1))
+
+
+def _state(spec):
+    return {"q": jnp.zeros(spec.stacked_shape_zyx(), jnp.float32)}
+
+
+def test_nan_burst_is_seed_deterministic_and_refire_stable():
+    spec = _spec()
+    where = []
+    for _ in range(2):
+        plan = FaultPlan(parse_spec("nan@3:repeat=always"), seed=1)
+        st = plan.fire_due(_state(spec), 2, 3, spec=spec)
+        where.append(np.argwhere(np.isnan(np.asarray(st["q"]))))
+        # re-fire (as after a rollback): the SAME cells again
+        st2 = plan.fire_due(_state(spec), 2, 3, spec=spec)
+        assert np.array_equal(where[-1],
+                              np.argwhere(np.isnan(np.asarray(st2["q"]))))
+    assert np.array_equal(where[0], where[1])
+    assert len(where[0]) == 2 ** 3  # default cells=2 cube
+    other = FaultPlan(parse_spec("nan@3"), seed=2).fire_due(
+        _state(spec), 2, 3, spec=spec)
+    assert not np.array_equal(
+        where[0], np.argwhere(np.isnan(np.asarray(other["q"]))))
+
+
+def test_inf_burst_and_quantity_targeting():
+    spec = _spec()
+    st = {"a": jnp.zeros(spec.stacked_shape_zyx(), jnp.float32),
+          "b": jnp.zeros(spec.stacked_shape_zyx(), jnp.float32)}
+    plan = FaultPlan(parse_spec("inf@1:q=b"))
+    out = plan.fire_due(st, 0, 1, spec=spec)
+    assert np.isinf(np.asarray(out["b"])).any()
+    assert not np.isinf(np.asarray(out["a"])).any()
+
+
+def test_burst_lands_inside_compute_interior():
+    spec = _spec()
+    plan = FaultPlan(parse_spec("nan@1:cells=3"), seed=3)
+    out = plan.fire_due(_state(spec), 0, 1, spec=spec)
+    idx = np.argwhere(np.isnan(np.asarray(out["q"])))
+    off = spec.compute_offset()
+    for _bz, _by, bx, z, y, x in idx:
+        sz = spec.block_size((int(bx), 0, 0))
+        assert off.z <= z < off.z + sz.z
+        assert off.y <= y < off.y + sz.y
+        assert off.x <= x < off.x + sz.x
+
+
+def test_halo_corrupts_wire_visible_boundary_slab():
+    spec = _spec()
+    plan = FaultPlan(parse_spec("halo@1"), seed=0)
+    out = plan.fire_due(_state(spec), 0, 1, spec=spec)
+    idx = np.argwhere(np.isnan(np.asarray(out["q"])))
+    assert len(idx)
+    off = spec.compute_offset()
+    r = spec.radius.dir(0, 0, 1)
+    for _bz, _by, bx, z, _y, _x in idx:
+        sz = spec.block_size((int(bx), 0, 0))
+        # the high-z interior boundary rows (what the next exchange sends)
+        assert off.z + sz.z - r <= z < off.z + sz.z
+
+
+def test_specless_flat_corruption():
+    plan = FaultPlan(parse_spec("nan@1:cells=5"))
+    out = plan.fire_due({"q": jnp.zeros((4, 4), jnp.float32)}, 0, 1)
+    assert int(np.isnan(np.asarray(out["q"])).sum()) == 5
+
+
+def test_slow_injection_sleeps_and_continues():
+    plan = FaultPlan(parse_spec("slow@1:seconds=0.01"))
+    st = {"q": jnp.zeros((2,), jnp.float32)}
+    out = plan.fire_due(st, 0, 1)
+    assert np.array_equal(np.asarray(out["q"]), np.zeros(2, np.float32))
+    assert plan.injections[0].fired == 1
+
+
+def test_injected_record_is_schema_valid(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    telemetry.configure(metrics_out=path, app="test")
+    try:
+        spec = _spec()
+        FaultPlan(parse_spec("nan@4")).fire_due(_state(spec), 3, 4, spec=spec)
+    finally:
+        telemetry.configure(metrics_out=None)
+    recs = [json.loads(line) for line in open(path) if line.strip()]
+    inj = [r for r in recs if r["name"] == "fault.injected"]
+    assert len(inj) == 1
+    assert telemetry.validate_record(inj[0]) == []
+    assert inj[0]["fault_kind"] == "nan" and inj[0]["step"] == 4
+    assert inj[0]["quantity"] == "q"
+
+
+# -- checkpoint truncation ----------------------------------------------------
+
+
+def test_ckpt_truncate_hits_newest_snapshot(tmp_path):
+    from stencil_tpu.ckpt import find_resume, write_snapshot
+
+    spec = GridSpec(Dim3(8, 6, 4), Dim3(2, 1, 1), Radius.constant(1))
+    st = {"q": np.random.RandomState(0).rand(
+        *spec.stacked_shape_zyx()).astype(np.float32)}
+    d = str(tmp_path)
+    write_snapshot(d, 1, spec, st, keep=5)
+    write_snapshot(d, 2, spec, st, keep=5)
+    path = truncate_newest_payload(d)
+    assert path and snapshot_dir(path) == "step-00000002"
+    assert os.path.getsize(path) == 16
+    # auto-resume now falls back past it
+    snap, manifest = find_resume(d)
+    assert manifest["step"] == 1
+
+
+def snapshot_dir(payload_path):
+    return os.path.basename(os.path.dirname(payload_path))
+
+
+def test_ckpt_truncate_no_snapshots_is_none(tmp_path):
+    assert truncate_newest_payload(str(tmp_path)) is None

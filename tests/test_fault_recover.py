@@ -1,0 +1,289 @@
+"""The rollback-with-backoff recovery engine (stencil_tpu/fault/recover.py).
+
+Engine-level pins with scripted step/save/restore hooks (tiny jnp state,
+no domain, no app): plain-loop degeneration, the step -> inject -> check
+-> checkpoint ordering (a poisoned state is never persisted), rollback
+to the newest valid snapshot, quarantine of a poisoned restore,
+exponential backoff, and the evidence-bundle abort with FAULT_RC."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.fault import (
+    FAULT_RC,
+    FaultPlan,
+    HealthGuard,
+    NumericalFault,
+    RecoveryExhausted,
+    RecoveryPolicy,
+    chunk_plan,
+    parse_spec,
+    run_guarded,
+)
+
+
+# -- chunk_plan ---------------------------------------------------------------
+
+
+def test_chunk_plan_basic():
+    assert chunk_plan(0, 10, 4) == [4, 4, 2]
+    assert chunk_plan(3, 10, 4) == [4, 3]
+    assert chunk_plan(10, 10, 4) == []
+
+
+def test_chunk_plan_breaks_at_cadences_and_steps():
+    # ckpt every 2 clamps like the historical jacobi plan
+    assert chunk_plan(0, 6, 10, every=(2,)) == [2, 2, 2]
+    # health cadence 3 + injection at 5: boundaries at 3, 5
+    assert chunk_plan(0, 9, 10, every=(3,), at=(5,)) == [3, 2, 1, 3]
+    # zero cadences are ignored
+    assert chunk_plan(0, 6, 10, every=(0, 0)) == [6]
+    # injection at/beyond the end adds no boundary
+    assert chunk_plan(0, 6, 10, at=(6, 9)) == [6]
+
+
+# -- a tiny scripted workload -------------------------------------------------
+# state: {"q": scalar-ish array}; step k adds k (so the final value equals
+# the step count and bit-exactness is trivially checkable)
+
+
+def _mk(start=0.0):
+    return {"q": jnp.full((4,), float(start), jnp.float32)}
+
+
+def _step(st, k):
+    return {"q": st["q"] + k}
+
+
+class MemCkpt:
+    """In-memory snapshot store standing in for ckpt/ (the real store is
+    exercised end-to-end in test_fault_e2e.py / ci_fault_gate.py)."""
+
+    def __init__(self):
+        self.snaps = {}
+        self.quarantined = []
+
+    def save(self, step, st):
+        self.snaps[step] = np.asarray(st["q"]).copy()
+
+    def restore(self):
+        if not self.snaps:
+            return None
+        step = max(self.snaps)
+        return step, {"q": jnp.asarray(self.snaps[step])}
+
+    def quarantine(self, step):
+        self.quarantined.append(step)
+        del self.snaps[step]
+
+
+def test_plain_loop_degeneration():
+    """No guard/injector/restore: the engine IS the historical chunk loop
+    — same chunk sequence, same save boundaries, same final state."""
+    ck = MemCkpt()
+    seen = []
+
+    def on_chunk(st, k, per, done):
+        seen.append((k, done))
+
+    state, done = run_guarded(
+        _mk(), start=0, iters=10,
+        plan_fn=lambda s: chunk_plan(s, 10, 4, every=(2,)),
+        step_fn=_step, save_fn=ck.save, ckpt_every=2, on_chunk=on_chunk)
+    assert done == 10
+    assert np.all(np.asarray(state["q"]) == 10)
+    assert seen == [(2, 2), (2, 4), (2, 6), (2, 8), (2, 10)]
+    # saves at every interior ckpt boundary, never the final step (the
+    # apps own the final save)
+    assert sorted(ck.snaps) == [2, 4, 6, 8]
+
+
+def test_rollback_restores_and_recomputes_bit_identically():
+    clean, _ = run_guarded(
+        _mk(), start=0, iters=8,
+        plan_fn=lambda s: chunk_plan(s, 8, 3, every=(2,)), step_fn=_step)
+    ck = MemCkpt()
+    plan = FaultPlan(parse_spec("nan@5"))
+    state, done = run_guarded(
+        _mk(), start=0, iters=8,
+        plan_fn=lambda s: chunk_plan(s, 8, 3, every=(2, 2), at=plan.steps()),
+        step_fn=_step, guard=HealthGuard(every=2), injector=plan,
+        policy=RecoveryPolicy(backoff_s=0.001),
+        save_fn=ck.save, ckpt_every=2, restore_fn=ck.restore)
+    assert done == 8
+    assert np.array_equal(np.asarray(state["q"]), np.asarray(clean["q"]))
+    # the check precedes every save: no persisted snapshot carries the NaN
+    for step, snap in ck.snaps.items():
+        assert np.isfinite(snap).all(), f"poisoned snapshot at {step}"
+
+
+def test_save_off_health_cadence_is_still_checked():
+    """A ckpt boundary that is NOT a health boundary (ckpt_every=2,
+    health_every=4, fault at 5 → save due at 6) still health-checks
+    first: the poisoned state is never persisted, the rollback lands on
+    the clean step-4 snapshot, and no quarantine is ever needed."""
+    clean, _ = run_guarded(
+        _mk(), start=0, iters=8,
+        plan_fn=lambda s: chunk_plan(s, 8, 3, every=(2,)), step_fn=_step)
+    ck = MemCkpt()
+    plan = FaultPlan(parse_spec("nan@5"))
+    state, done = run_guarded(
+        _mk(), start=0, iters=8,
+        plan_fn=lambda s: chunk_plan(s, 8, 3, every=(2, 4), at=plan.steps()),
+        step_fn=_step, guard=HealthGuard(every=4), injector=plan,
+        policy=RecoveryPolicy(backoff_s=0.001),
+        save_fn=ck.save, ckpt_every=2, restore_fn=ck.restore,
+        quarantine_fn=ck.quarantine)
+    assert done == 8
+    assert np.array_equal(np.asarray(state["q"]), np.asarray(clean["q"]))
+    for step, snap in ck.snaps.items():
+        assert np.isfinite(snap).all(), f"poisoned snapshot at {step}"
+    assert ck.quarantined == []
+
+
+def test_pre_start_injections_warn_and_never_fire():
+    """A resumed run whose injection step already passed completes clean
+    (the spec is warned about, not silently vacuous)."""
+    plan = FaultPlan(parse_spec("nan@2"))
+    state, done = run_guarded(
+        _mk(4.0), start=4, iters=8,
+        plan_fn=lambda s: chunk_plan(s, 8, 3, at=plan.steps()),
+        step_fn=_step, guard=HealthGuard(every=2), injector=plan)
+    assert done == 8
+    assert np.isfinite(np.asarray(state["q"])).all()
+    assert plan.injections[0].fired == 0
+
+
+def test_detection_within_health_every():
+    ck = MemCkpt()
+    plan = FaultPlan(parse_spec("nan@3"))
+    faults = []
+    orig_check = HealthGuard.check
+
+    class Spy(HealthGuard):
+        def check(self, state, step):
+            try:
+                orig_check(self, state, step)
+            except NumericalFault as f:
+                faults.append(f)
+                raise
+
+    state, _ = run_guarded(
+        _mk(), start=0, iters=8,
+        plan_fn=lambda s: chunk_plan(s, 8, 8, every=(2, 2), at=plan.steps()),
+        step_fn=_step, guard=Spy(every=2), injector=plan,
+        policy=RecoveryPolicy(backoff_s=0.001),
+        save_fn=ck.save, ckpt_every=2, restore_fn=ck.restore)
+    assert faults and faults[0].step - 3 <= 2
+
+
+def test_no_restore_aborts_with_evidence(tmp_path):
+    plan = FaultPlan(parse_spec("inf@2"))
+    with pytest.raises(RecoveryExhausted) as ei:
+        run_guarded(
+            _mk(), start=0, iters=4,
+            plan_fn=lambda s: chunk_plan(s, 4, 4, every=(2,), at=plan.steps()),
+            step_fn=_step, guard=HealthGuard(every=2), injector=plan,
+            evidence_dir=str(tmp_path), app="unit")
+    e = ei.value
+    assert "cannot roll back" in e.reason
+    assert e.evidence_path and os.path.isfile(e.evidence_path)
+    ev = json.load(open(e.evidence_path))
+    assert ev["rc"] == FAULT_RC == 43
+    assert ev["app"] == "unit"
+    assert ev["faults"][0]["kind"] == "nonfinite"
+    assert ev["injections"][0]["kind"] == "inf"
+
+
+def test_max_rollbacks_exhaustion_and_backoff(tmp_path, monkeypatch):
+    sleeps = []
+    import stencil_tpu.fault.recover as recover
+
+    monkeypatch.setattr(recover.time, "sleep", lambda s: sleeps.append(s))
+    ck = MemCkpt()
+    plan = FaultPlan(parse_spec("nan@3:repeat=always"))
+    with pytest.raises(RecoveryExhausted) as ei:
+        run_guarded(
+            _mk(), start=0, iters=8,
+            plan_fn=lambda s: chunk_plan(s, 8, 8, every=(2, 2),
+                                         at=plan.steps()),
+            step_fn=_step, guard=HealthGuard(every=2), injector=plan,
+            policy=RecoveryPolicy(max_rollbacks=2, backoff_s=0.5),
+            save_fn=ck.save, ckpt_every=2, restore_fn=ck.restore,
+            evidence_dir=str(tmp_path))
+    assert "max rollbacks (2) exceeded" in ei.value.reason
+    assert ei.value.rollbacks == 3
+    assert sleeps == [0.5, 1.0]  # exponential backoff per repeat
+
+
+def test_poisoned_restore_is_quarantined(tmp_path):
+    """A snapshot that restores to unhealthy state is quarantined and the
+    next candidate is used — rollback never reinstalls the disease."""
+    ck = MemCkpt()
+    plan = FaultPlan(parse_spec("nan@5"))
+
+    def poisoning_save(step, st):
+        ck.save(step, st)
+        if step == 4:  # corrupt the stored copy AFTER the healthy save
+            ck.snaps[4][0] = np.nan
+
+    state, done = run_guarded(
+        _mk(), start=0, iters=8,
+        plan_fn=lambda s: chunk_plan(s, 8, 8, every=(2, 2), at=plan.steps()),
+        step_fn=_step, guard=HealthGuard(every=2), injector=plan,
+        policy=RecoveryPolicy(backoff_s=0.001),
+        save_fn=poisoning_save, ckpt_every=2, restore_fn=ck.restore,
+        quarantine_fn=ck.quarantine, evidence_dir=str(tmp_path))
+    assert done == 8
+    assert ck.quarantined == [4]
+    assert np.isfinite(np.asarray(state["q"])).all()
+
+
+def test_rollback_telemetry_records(tmp_path):
+    from stencil_tpu.obs import telemetry
+
+    path = str(tmp_path / "m.jsonl")
+    telemetry.configure(metrics_out=path, app="unit")
+    try:
+        ck = MemCkpt()
+        plan = FaultPlan(parse_spec("nan@3"))
+        run_guarded(
+            _mk(), start=0, iters=6,
+            plan_fn=lambda s: chunk_plan(s, 6, 6, every=(2, 2),
+                                         at=plan.steps()),
+            step_fn=_step, guard=HealthGuard(every=2), injector=plan,
+            policy=RecoveryPolicy(backoff_s=0.001),
+            save_fn=ck.save, ckpt_every=2, restore_fn=ck.restore)
+    finally:
+        telemetry.configure(metrics_out=None)
+    recs = [json.loads(line) for line in open(path) if line.strip()]
+    for r in recs:
+        assert telemetry.validate_record(r) == [], r
+    by_name = {}
+    for r in recs:
+        by_name.setdefault(r["name"], []).append(r)
+    assert "fault.injected" in by_name
+    assert "health.fault" in by_name
+    assert "recover.fault" in by_name
+    (rb,) = by_name["recover.rollback"]
+    assert rb["to_step"] == 2 and rb["fault_step"] == 4
+    assert by_name["recover.backoff_s"][0]["value"] == pytest.approx(0.001)
+
+
+def test_flush_called_before_restore_and_disk_injections():
+    calls = []
+    ck = MemCkpt()
+    plan = FaultPlan(parse_spec("ckpt-truncate@3,nan@3"))
+    run_guarded(
+        _mk(), start=0, iters=6,
+        plan_fn=lambda s: chunk_plan(s, 6, 6, every=(2, 2), at=plan.steps()),
+        step_fn=_step, guard=HealthGuard(every=2), injector=plan,
+        policy=RecoveryPolicy(backoff_s=0.001),
+        save_fn=ck.save, ckpt_every=2, restore_fn=ck.restore,
+        flush_fn=lambda: calls.append("flush"))
+    # once for the ckpt-truncate injection, once before the rollback read
+    assert calls.count("flush") >= 2

@@ -206,3 +206,36 @@ def test_machine_info_json_records():
     assert len(dm["matrix"]) == 8 and len(dm["matrix"][0]) == 8
     part = next(rr for rr in recs if rr["name"] == "machine.partition")
     assert len(part["dim"]) == 3
+
+
+def test_validate_record_fault_vocabulary():
+    """The fault.*/health.*/recover.* records carry typed payload fields
+    (schema NAME_FIELDS) — the CI fault gate greps these, so an untyped
+    or missing field must fail validation, not a post-mortem."""
+    base = {"v": 1, "run": "r", "proc": 0, "t": 0.0}
+    ok = dict(base, kind="meta", name="fault.injected",
+              fault_kind="nan", step=3)
+    assert telemetry.validate_record(ok) == []
+    missing = dict(base, kind="meta", name="fault.injected", fault_kind="nan")
+    assert any("step" in e for e in telemetry.validate_record(missing))
+    badtype = dict(base, kind="meta", name="health.fault",
+                   fault_kind="nonfinite", quantity=7, step=1)
+    assert any("quantity" in e for e in telemetry.validate_record(badtype))
+    # bools are not ints for step-typed fields
+    booly = dict(base, kind="meta", name="recover.fault",
+                 fault_kind="nonfinite", step=True)
+    assert any("step" in e for e in telemetry.validate_record(booly))
+    rb = dict(base, kind="counter", name="recover.rollback", value=1,
+              from_step=4, to_step=2, fault_step=4)
+    assert telemetry.validate_record(rb) == []
+    rb_bad = dict(rb)
+    del rb_bad["to_step"]
+    assert any("to_step" in e for e in telemetry.validate_record(rb_bad))
+    span = dict(base, kind="span", name="health.check", seconds=0.01, step=2)
+    assert telemetry.validate_record(span) == []
+    skip = dict(base, kind="counter", name="ckpt.save_skipped", value=1,
+                reason="multi-process writes unsupported")
+    assert telemetry.validate_record(skip) == []
+    skip_bad = dict(skip)
+    del skip_bad["reason"]
+    assert any("reason" in e for e in telemetry.validate_record(skip_bad))

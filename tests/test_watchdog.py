@@ -156,3 +156,49 @@ def test_revival_no_result_and_budget_refusal():
         "floored", [PY, "-c", "print('RESULT {\"v\": 1}')"],
         timeout_s=30, floor_timeout_s=30.0,
     ) == {"v": 1}
+
+
+def test_supervise_classifies_fault_rc():
+    """rc 43 (stencil_tpu.fault.recover's rollback-exhausted abort) is
+    the FAULT outcome — distinct from a generic crash — and the contract
+    constant matches the fault package's."""
+    from stencil_tpu.fault import FAULT_RC
+
+    assert watchdog.FAULT_RC == FAULT_RC == 43
+    att = watchdog.supervise([PY, "-c", "import sys; sys.exit(43)"],
+                             timeout_s=60, name="faulting")
+    assert att.outcome == watchdog.FAULT
+    assert att.rc == 43
+    # an explicit fault_rc=None turns the classification off
+    att = watchdog.supervise([PY, "-c", "import sys; sys.exit(43)"],
+                             timeout_s=60, name="plain", fault_rc=None)
+    assert att.outcome == watchdog.CRASH
+
+
+def test_supervise_archives_metrics_evidence(tmp_path):
+    """On a bad outcome the child's metrics JSONL is archived next to the
+    log (auto-detected from STENCIL_METRICS_OUT in the child's env) —
+    post-mortems get telemetry, not just stdout."""
+    metrics = str(tmp_path / "child-metrics.jsonl")
+    child = (
+        "import os, sys\n"
+        "open(os.environ['STENCIL_METRICS_OUT'], 'w')"
+        ".write('{\"fake\": 1}\\n')\n"
+        "sys.exit(43)\n"
+    )
+    env = dict(os.environ)
+    env["STENCIL_METRICS_OUT"] = metrics
+    att = watchdog.supervise([PY, "-c", child], timeout_s=60, env=env,
+                             name="evidence", archive_dir=str(tmp_path / "a"))
+    assert att.outcome == watchdog.FAULT
+    assert att.metrics_log_path and os.path.exists(att.metrics_log_path)
+    assert att.metrics_log_path.endswith(".metrics.jsonl")
+    assert open(att.metrics_log_path).read() == '{"fake": 1}\n'
+    assert att.summary()["metrics"] == att.metrics_log_path
+    # a healthy child's metrics are NOT archived (evidence is for failures)
+    env2 = dict(env)
+    att2 = watchdog.supervise([PY, "-c", "print('fine')"], timeout_s=60,
+                              env=env2, name="healthy",
+                              archive_dir=str(tmp_path / "a"))
+    assert att2.outcome == watchdog.OK
+    assert att2.metrics_log_path is None
