@@ -376,25 +376,56 @@ def _child_main(mode: str, resume: bool = False) -> int:
 # --------------------------------------------------------------- parent side
 
 
-def _load_watchdog():
-    """Load stencil_tpu/obs/watchdog.py by FILE PATH.
+def _load_obs(stem: str, modname: str):
+    """Load a stencil_tpu/obs/ module by FILE PATH.
 
     The parent must never import the ``stencil_tpu`` package: its
     ``__init__`` imports jax, and the wedge being supervised lives in JAX
-    backend/plugin machinery. watchdog.py is pure stdlib by contract."""
+    backend/plugin machinery. watchdog.py and ledger.py are pure stdlib
+    by contract."""
     import importlib.util
 
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        "stencil_tpu", "obs", "watchdog.py",
+        "stencil_tpu", "obs", f"{stem}.py",
     )
-    spec = importlib.util.spec_from_file_location("stencil_watchdog", path)
+    spec = importlib.util.spec_from_file_location(modname, path)
     mod = importlib.util.module_from_spec(spec)
     # register BEFORE exec: dataclasses resolves string annotations through
     # sys.modules[cls.__module__]
-    sys.modules["stencil_watchdog"] = mod
+    sys.modules[modname] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_watchdog():
+    return _load_obs("watchdog", "stencil_watchdog")
+
+
+def _append_ledger(payload: dict) -> None:
+    """Append the round's payload to the performance ledger named by
+    STENCIL_BENCH_LEDGER (no-op otherwise): the driver's one JSON line
+    becomes durable, diffable history that ``perf_tool trend``/``gate``
+    read across rounds. STENCIL_BENCH_LABEL names the round (default: a
+    timestamp label). Best-effort by design — a ledger problem must never
+    cost the driver its payload line or the rc=0 contract."""
+    path = os.environ.get("STENCIL_BENCH_LEDGER")
+    if not path:
+        return
+    try:
+        ledger = _load_obs("ledger", "stencil_ledger")
+        label = (os.environ.get(ledger.ENV_LABEL)
+                 or time.strftime("bench-%Y%m%dT%H%M%S"))
+        entries = ledger.entries_from_bench_payload(
+            payload, label=label,
+            rev=ledger.git_rev(os.path.dirname(os.path.abspath(__file__))),
+            source="bench")
+        n = ledger.append_entries(path, entries)
+        print(f"[bench] ledger: +{n} entries ({label}) -> {path}",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — evidence, never the measurement
+        print(f"[bench] ledger append failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
 
 
 def _parse_sentinel(stdout: str) -> dict | None:
@@ -461,28 +492,29 @@ def main() -> int:
         payload = child(mode, timeout_s, resume=i > 0)
         if payload is not None:
             print(json.dumps(payload), flush=True)
+            _append_ledger(payload)
             return 0
     payload = child("cpu", max(30.0, rev.remaining() - 5.0), floor_s=30.0,
                     resume=True)
     if payload is not None:
         print(json.dumps(payload), flush=True)
+        _append_ledger(payload)
         return 0
     # last resort: the driver still gets its one line and rc=0; the
     # attempt ladder (outcomes, archived logs) goes to stderr as evidence
     print(f"[bench] all children failed; attempts: "
           f"{json.dumps(rev.report())}", file=sys.stderr, flush=True)
-    print(
-        json.dumps(
-            {
-                "metric": "jacobi3d_512_mcells_per_s_per_chip",
-                "value": 0.0,
-                "unit": "Mcells/s",
-                "vs_baseline": 0.0,
-                "detail": {"error": "all bench children failed; see stderr"},
-            }
-        ),
-        flush=True,
-    )
+    payload = {
+        "metric": "jacobi3d_512_mcells_per_s_per_chip",
+        "value": 0.0,
+        "unit": "Mcells/s",
+        "vs_baseline": 0.0,
+        "detail": {"error": "all bench children failed; see stderr"},
+    }
+    print(json.dumps(payload), flush=True)
+    # the outage round must land in the ledger too — the trend shows the
+    # zero instead of skipping the round (the r03 discipline)
+    _append_ledger(payload)
     return 0
 
 

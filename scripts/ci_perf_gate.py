@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""CI performance-ledger gate: ingest -> trend -> sentinel both directions.
+
+The executable acceptance proof of the cross-run observability layer
+(obs/ledger.py + apps/perf_tool.py + obs/trace_export.py) on the
+8-virtual-device CPU mesh — no TPU needed:
+
+1. baseline pair: jacobi3d 24^3 runs TWICE with ``--metrics-out``; each
+   run's gauge trimeans are ingested into a fresh ledger under labels
+   run1/run2, and the sentinel must PASS run2 against run1's band for
+   the tracked wall-clock leg (``jacobi.loop_wall_s``);
+2. regression trip: a third run is synthetically slowed with the
+   fault-injection registry's ``slow:`` kind (``--inject
+   slow@3:seconds=S`` — the sleep lands inside the guarded loop, so the
+   wall-clock leg inflates while the per-chunk step spans stay clean);
+   the sentinel must exit NONZERO and name the tripped leg;
+3. ledger schema: the committed LEDGER.jsonl passes ``report --validate
+   --ledger`` and ``perf_tool trend`` over it renders the real r01->r05
+   trajectory (the 83.1 Gcells/s r05 flagship with its round label);
+   a deliberately corrupted copy is REJECTED;
+4. trace timeline: a ci_fault_gate-style run (``--inject nan@3`` +
+   checkpoints) is exported via ``report --trace-out`` and must validate
+   as Chrome-trace JSON with per-(run, proc) lanes and
+   ``fault.injected``/``recover.rollback``/``ckpt.save`` instant events;
+5. artifacts: the rendered markdown dashboard + trace JSON land in
+   ``--out-dir`` for CI upload.
+
+Exit code 0 only if every stage holds. Run from the repo root:
+
+  python scripts/ci_perf_gate.py [--size 24] [--iters 6] [--out-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+TRACKED_LEG = "jacobi.loop_wall_s"
+
+
+def run(cmd, expect_rc=0, name=""):
+    print(f"[perf-gate] {name}: {' '.join(cmd)}", flush=True)
+    p = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    if p.returncode != expect_rc:
+        print(p.stdout)
+        print(p.stderr, file=sys.stderr)
+        raise SystemExit(
+            f"[perf-gate] {name}: rc={p.returncode}, expected {expect_rc}")
+    return p
+
+
+def jacobi(args, metrics, extra=(), name=""):
+    cmd = [
+        PY, "-m", "stencil_tpu.apps.jacobi3d", "--cpu", "8",
+        "--x", str(args.size), "--y", str(args.size), "--z", str(args.size),
+        "--iters", str(args.iters), "--metrics-out", metrics,
+    ] + list(extra)
+    return run(cmd, name=name)
+
+
+def ingest(ledger, metrics, label):
+    run([PY, "-m", "stencil_tpu.apps.perf_tool", "ingest",
+         "--ledger", ledger, "--label", label, "--platform", "cpu", metrics],
+        name=f"ingest-{label}")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=24)
+    p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--slow-s", type=float, default=8.0,
+                   help="injected slowdown (must dwarf CPU-mesh noise)")
+    # the tracked leg is a ~0.1 s wall clock on a loaded CI box: single
+    # measurements swing several-fold, so the stable band must be wide.
+    # The injected 8 s sleep is >50x the baseline — the trip margin stays
+    # enormous even at rel_tol 2 (band hi = 3x center).
+    p.add_argument("--rel-tol", type=float, default=2.0,
+                   help="band floor for the stable pair (CPU timing is "
+                        "noisy; the injected slowdown is far larger)")
+    p.add_argument("--out-dir", default="",
+                   help="keep dashboard + trace here for CI artifacts "
+                        "(default: a temp dir, removed)")
+    args = p.parse_args()
+
+    work = tempfile.mkdtemp(prefix="perf-gate-")
+    out_dir = os.path.abspath(args.out_dir) if args.out_dir else work
+    os.makedirs(out_dir, exist_ok=True)
+    ledger = os.path.join(out_dir, "ledger.jsonl")
+    # a stale ledger from a previous invocation would dedup this run's
+    # entries away (same metric/config/rev/label keys) and the gate would
+    # judge the OLD measurements — every invocation starts fresh
+    if os.path.exists(ledger):
+        os.remove(ledger)
+    try:
+        # 1. stable pair -> sentinel PASS
+        for i in (1, 2):
+            m = os.path.join(work, f"m{i}.jsonl")
+            jacobi(args, m, name=f"stable-run{i}")
+            ingest(ledger, m, f"run{i}")
+        g = run([PY, "-m", "stencil_tpu.apps.perf_tool", "gate",
+                 "--ledger", ledger, "--metric", TRACKED_LEG,
+                 "--label", "run2", "--rel-tol", str(args.rel_tol)],
+                name="gate-stable")
+        if f"GATE PASS {TRACKED_LEG}" not in g.stdout:
+            raise SystemExit(f"[perf-gate] stable pair did not PASS the "
+                             f"sentinel:\n{g.stdout}")
+
+        # 2. injected slowdown -> sentinel TRIPS with the leg named.
+        # slow@K sleeps inside the guarded loop (fault/inject.py), so the
+        # wall-clock leg inflates while per-chunk step spans stay honest.
+        m3 = os.path.join(work, "m3.jsonl")
+        jacobi(args, m3,
+               extra=["--inject", f"slow@3:seconds={args.slow_s}"],
+               name="slowed-run")
+        ingest(ledger, m3, "run3")
+        g = run([PY, "-m", "stencil_tpu.apps.perf_tool", "gate",
+                 "--ledger", ledger, "--metric", TRACKED_LEG,
+                 "--label", "run3", "--rel-tol", str(args.rel_tol)],
+                expect_rc=1, name="gate-slowed")
+        if f"GATE FAIL {TRACKED_LEG}" not in g.stdout:
+            raise SystemExit(f"[perf-gate] slowed run did not trip the "
+                             f"sentinel by name:\n{g.stdout}")
+
+        # 3. committed ledger: schema-valid, renders the real trajectory
+        run([PY, "-m", "stencil_tpu.apps.report", os.path.join(work, "m1.jsonl"),
+             "--validate", "--ledger", os.path.join(REPO, "LEDGER.jsonl")],
+            name="ledger-schema")
+        t = run([PY, "-m", "stencil_tpu.apps.perf_tool", "trend",
+                 "--ledger", os.path.join(REPO, "LEDGER.jsonl"),
+                 "--metric", "jacobi3d_512_mcells_per_s_per_chip"],
+                name="trend-committed")
+        if "r05" not in t.stdout or "83059.7" not in t.stdout:
+            raise SystemExit(f"[perf-gate] committed LEDGER.jsonl does not "
+                             f"render the r05 flagship:\n{t.stdout}")
+        # corruption must be rejected loudly, not aggregated
+        bad = os.path.join(work, "bad-ledger.jsonl")
+        shutil.copyfile(os.path.join(REPO, "LEDGER.jsonl"), bad)
+        with open(bad, "a") as f:
+            f.write('{"v": 1, "kind": "perf-ledger", "metric": ""}\n')
+        run([PY, "-m", "stencil_tpu.apps.report", os.path.join(work, "m1.jsonl"),
+             "--validate", "--ledger", bad], expect_rc=1,
+            name="ledger-corruption-rejected")
+
+        # 4. trace timeline from a fault-gate-style self-healing run
+        m4 = os.path.join(work, "m4.jsonl")
+        jacobi(args, m4,
+               extra=["--ckpt-dir", os.path.join(work, "ck"),
+                      "--ckpt-every", "2", "--health-every", "2",
+                      "--rollback-backoff", "0.05", "--inject", "nan@3"],
+               name="fault-run")
+        trace = os.path.join(out_dir, "trace.json")
+        run([PY, "-m", "stencil_tpu.apps.report", m4, "--trace-out", trace],
+            name="trace-export")
+        with open(trace) as f:
+            tr = json.load(f)
+        sys.path.insert(0, REPO)
+        from stencil_tpu.obs import trace_export
+
+        errs = trace_export.validate_trace(tr)
+        if errs:
+            raise SystemExit(f"[perf-gate] invalid trace: {errs[:3]}")
+        inst = {e["name"] for e in tr["traceEvents"] if e.get("ph") == "i"}
+        need = {"fault.injected", "recover.rollback", "ckpt.save"}
+        if not need <= inst:
+            raise SystemExit(f"[perf-gate] trace lacks instant markers "
+                             f"{sorted(need - inst)} (has {sorted(inst)})")
+        lanes = {(e.get("pid"), e.get("tid"))
+                 for e in tr["traceEvents"] if e.get("ph") == "X"}
+        if not lanes:
+            raise SystemExit("[perf-gate] trace has no (run, proc) span lanes")
+
+        # 5. dashboard artifact
+        run([PY, "-m", "stencil_tpu.apps.perf_tool", "render",
+             "--ledger", ledger,
+             "--out", os.path.join(out_dir, "dashboard.md")],
+            name="render-dashboard")
+
+        print(f"[perf-gate] PASS (artifacts: {out_dir})")
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

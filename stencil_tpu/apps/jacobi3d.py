@@ -289,6 +289,7 @@ def run(
             quarantine_snapshot(ckpt_dir, snapshot_name(s),
                                 reason="restored state failed health check")
 
+    loop_t0 = time.perf_counter()
     state, done = run_guarded(
         {"temperature": curr},
         start=start, iters=iters, plan_fn=plan_fn, step_fn=step_fn,
@@ -299,6 +300,11 @@ def run(
         quarantine_fn=quarantine_fn, flush_fn=flush_fn, on_chunk=on_chunk,
         spec=dd.spec, ckpt_dir=ckpt_dir, app="jacobi3d",
     )
+    # whole-loop wall clock, INCLUDING what the per-chunk spans exclude
+    # (health checks, checkpoint saves, injected faults, backoff and
+    # rollback recovery) — the ledger gate's wall-level regression leg
+    # (scripts/ci_perf_gate.py trips it with an injected slow: fault)
+    loop_wall_s = time.perf_counter() - loop_t0
     curr = state["temperature"]
     if ckpt_dir:
         if done > start or start == 0:
@@ -387,6 +393,7 @@ def run(
         "handle": h,
     }
     if rec.enabled:
+        rec.gauge("jacobi.loop_wall_s", loop_wall_s, phase="step", unit="s")
         rec.gauge("jacobi.mcells_per_s", result["mcells_per_s"], phase="step")
         rec.gauge("jacobi.mcells_per_s_per_dev",
                   result["mcells_per_s_per_dev"], phase="step")

@@ -14,21 +14,33 @@ number of files/processes/runs, and reports:
   (BASELINE.json / a bench.py payload / any flat {name: number} map).
 
 ``--validate`` makes it the CI schema gate: every line must parse and
-satisfy the telemetry schema, or the exit code is 1.
+satisfy the telemetry schema, or the exit code is 1 (``--ledger`` extends
+the same gate to a performance-ledger file, ``obs/ledger.py`` schema).
+``--trace-out`` exports the records as a Chrome-trace/Perfetto timeline
+(``obs/trace_export.py``); ``--follow`` re-reads growing metrics files
+and re-renders the tables in place — a run-status view for long hardware
+sessions (add ``--heartbeat`` or set ``STENCIL_HEARTBEAT_FILE`` to also
+show watchdog heartbeat freshness).
 
 Usage:
   python -m stencil_tpu.apps.report m1.jsonl [m2.jsonl ...] [--markdown]
   python -m stencil_tpu.apps.report metrics.jsonl --validate
   python -m stencil_tpu.apps.report metrics.jsonl --baseline BASELINE.json
+  python -m stencil_tpu.apps.report metrics.jsonl --trace-out trace.json
+  python -m stencil_tpu.apps.report metrics.jsonl --follow
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..obs import telemetry
+from ..obs.watchdog import HEARTBEAT_FILE_ENV
 from ..utils.statistics import Statistics
 
 
@@ -208,18 +220,29 @@ def _flatten_numeric(obj, prefix: str = "") -> Dict[str, float]:
 def baseline_delta(agg: dict, baseline: dict,
                    markdown: bool = False) -> str:
     """Gauge-vs-baseline ratios for every gauge whose name matches a
-    numeric baseline entry (exact name, or last dotted component)."""
+    numeric baseline entry (exact name, or last dotted component).
+
+    When two baseline keys share a leaf name, the leaf match is
+    AMBIGUOUS: the row is flagged instead of silently ratio-ing against
+    whichever key flattened first (an exact full-name match is still
+    unambiguous and unaffected)."""
     flat = _flatten_numeric(baseline)
-    by_leaf: Dict[str, Tuple[str, float]] = {}
+    by_leaf: Dict[str, List[Tuple[str, float]]] = {}
     for k, v in flat.items():
-        by_leaf.setdefault(k.split(".")[-1], (k, v))
+        by_leaf.setdefault(k.split(".")[-1], []).append((k, v))
     rows: List[List[str]] = []
     for name, st in sorted(agg["gauges"].items()):
         match: Optional[Tuple[str, float]] = None
         if name in flat:
             match = (name, flat[name])
-        elif name.split(".")[-1] in by_leaf:
-            match = by_leaf[name.split(".")[-1]]
+        else:
+            cands = by_leaf.get(name.split(".")[-1], [])
+            if len(cands) > 1:
+                rows.append([name, f"{st.trimean():.6g}", "-", "AMBIGUOUS",
+                             ";".join(sorted(k for k, _v in cands))])
+                continue
+            if cands:
+                match = cands[0]
         if match is None or match[1] == 0:
             continue
         key, base = match
@@ -235,6 +258,62 @@ def baseline_delta(agg: dict, baseline: dict,
     return "\n".join(lines)
 
 
+def _heartbeat_line(hb_path: Optional[str]) -> str:
+    """One status line from the watchdog heartbeat file's mtime — the
+    same freshness signal the supervisor reads (obs/watchdog.py)."""
+    if not hb_path:
+        return "heartbeat: (no heartbeat file)"
+    try:
+        age = time.time() - os.stat(hb_path).st_mtime
+    except OSError:
+        return f"heartbeat: {hb_path} missing (child not started?)"
+    return f"heartbeat: {age:.1f}s ago ({hb_path})"
+
+
+def follow(paths: List[str], *, interval_s: float = 2.0, count: int = 0,
+           markdown: bool = False, heartbeat: Optional[str] = None,
+           out=None) -> int:
+    """Live tail: re-read the (growing) metrics files every
+    ``interval_s`` and re-render the span/gauge tables in place.
+
+    Files that do not exist yet are simply waited for (a run-status view
+    usually starts before the run). ``count`` bounds the redraws (0 =
+    until interrupted — the normal interactive mode)."""
+    out = out or sys.stdout
+    hb = heartbeat or os.environ.get(HEARTBEAT_FILE_ENV) or None
+    it = 0
+    # ^C is the documented way OUT of the live view — it must exit
+    # cleanly wherever it lands (with big files most wall time is in
+    # load/aggregate/render, not the sleep)
+    try:
+        while True:
+            it += 1
+            have = [p for p in paths if os.path.exists(p)]
+            try:
+                records, errors = load(have)
+            except OSError as e:
+                # a file can vanish between the exists() filter and open()
+                # (watchdog retry ladders rotate child logs) — wait for
+                # the next redraw instead of dying mid-view
+                records, errors = [], [str(e)]
+            body = (tables(aggregate(records), markdown=markdown) if records
+                    else f"(waiting for records in {', '.join(paths)})")
+            if getattr(out, "isatty", lambda: False)():
+                out.write("\x1b[2J\x1b[H")  # clear + home: render in place
+            stamp = time.strftime("%H:%M:%S")
+            out.write(f"-- follow #{it} @ {stamp} · "
+                      f"{len(have)}/{len(paths)} file(s) · "
+                      f"{len(errors)} schema error(s) · "
+                      f"{_heartbeat_line(hb)}\n")
+            out.write(body + "\n")
+            out.flush()
+            if count and it >= count:
+                return 0
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(
         description="aggregate telemetry metrics JSONL into trimean tables")
@@ -245,16 +324,84 @@ def main(argv: Optional[list] = None) -> int:
                    help="JSON of recorded numbers for a vs-baseline delta")
     p.add_argument("--validate", action="store_true",
                    help="schema-gate mode: exit 1 on any invalid line")
+    p.add_argument("--ledger", default="",
+                   help="also validate this performance-ledger file "
+                        "(obs/ledger.py schema) in --validate mode")
+    p.add_argument("--trace-out", default="",
+                   help="export the records as a Chrome-trace/Perfetto "
+                        "timeline JSON (one lane per (run, proc); fault/"
+                        "ckpt markers as instant events)")
+    p.add_argument("--follow", action="store_true",
+                   help="live tail: re-read growing metrics files and "
+                        "re-render in place")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--follow redraw period in seconds")
+    p.add_argument("--follow-count", type=int, default=0,
+                   help="stop --follow after N redraws (0 = until ^C)")
+    p.add_argument("--heartbeat", default="",
+                   help="watchdog heartbeat file whose freshness --follow "
+                        "shows (default: $STENCIL_HEARTBEAT_FILE)")
     p.add_argument("--out", default="", help="also write the report here")
     args = p.parse_args(argv)
+
+    # single-purpose modes ignore the other output flags — say so instead
+    # of silently producing no artifact
+    def _warn_ignored(mode: str, flags: List[Tuple[str, object]]) -> None:
+        ignored = [name for name, val in flags if val]
+        if ignored:
+            print(f"# {mode} mode ignores {', '.join(ignored)}",
+                  file=sys.stderr)
+
+    if args.follow:
+        _warn_ignored("--follow", [("--validate", args.validate),
+                                   ("--ledger", args.ledger),
+                                   ("--trace-out", args.trace_out),
+                                   ("--baseline", args.baseline),
+                                   ("--out", args.out)])
+        return follow(args.paths, interval_s=args.interval,
+                      count=args.follow_count, markdown=args.markdown,
+                      heartbeat=args.heartbeat or None)
+    if args.validate:
+        _warn_ignored("--validate", [("--trace-out", args.trace_out),
+                                     ("--baseline", args.baseline),
+                                     ("--out", args.out)])
 
     records, errors = load(args.paths)
     if errors:
         for e in errors:
             print(f"SCHEMA: {e}")
     if args.validate:
-        print(f"{len(records)} valid records, {len(errors)} schema errors")
+        ledger_msg = ""
+        if args.ledger:
+            from ..obs import ledger as ledger_mod
+
+            try:
+                if not os.path.exists(args.ledger):
+                    # load_ledger treats a missing file as an empty ledger
+                    # (fine for a first append) — but a GATE asked to
+                    # validate a path that is not there must fail, not
+                    # silently validate nothing
+                    raise ledger_mod.LedgerError(
+                        f"{args.ledger}: no such ledger file")
+                n_led = len(ledger_mod.load_ledger(args.ledger))
+                ledger_msg = f", ledger: {n_led} valid entries"
+            except ledger_mod.LedgerError as e:
+                print(f"SCHEMA: LEDGER: {e}")
+                errors.append(f"LEDGER: {e}")
+                ledger_msg = ", ledger: INVALID"
+        print(f"{len(records)} valid records, {len(errors)} schema errors"
+              + ledger_msg)
         return 1 if errors or not records else 0
+
+    # past this point nothing reads the ledger — a CI line that forgot
+    # --validate must hear that its ledger check did not happen
+    _warn_ignored("report", [("--ledger", args.ledger)])
+
+    if args.trace_out:
+        from ..obs import trace_export
+
+        n_ev = trace_export.write_trace(args.trace_out, records)
+        print(f"# trace: {n_ev} events -> {args.trace_out}")
 
     agg = aggregate(records)
     text = tables(agg, markdown=args.markdown)

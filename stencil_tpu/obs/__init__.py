@@ -1,6 +1,6 @@
-"""obs — the flight recorder: unified telemetry + the stall watchdog.
+"""obs — the flight recorder: telemetry, watchdog, ledger, trace export.
 
-Two halves, deliberately decoupled:
+Four parts, deliberately decoupled:
 
 - :mod:`stencil_tpu.obs.telemetry` — a structured recorder of spans,
   counters, and gauges flushed as one-JSON-object-per-line to a metrics
@@ -12,9 +12,19 @@ Two halves, deliberately decoupled:
   backoff, archives logs. Pure stdlib, importable WITHOUT importing jax
   (``bench.py``'s parent loads it by file path — the parent must never
   touch a JAX backend).
+- :mod:`stencil_tpu.obs.ledger` — the cross-run performance ledger:
+  append-only schema-validated entries keyed by (metric, platform,
+  config fingerprint, git rev, label), ingested from bench payloads and
+  metrics-JSONL gauge trimeans; ``apps/perf_tool.py`` renders trends and
+  runs the trimean ± MAD regression sentinel over it. Pure stdlib by the
+  same contract (``bench.py``'s parent appends the round payload when
+  ``STENCIL_BENCH_LEDGER`` is set).
+- :mod:`stencil_tpu.obs.trace_export` — metrics JSONL ->
+  Chrome-trace/Perfetto timeline JSON (one lane per (run, proc),
+  fault/checkpoint instant markers); ``apps/report.py --trace-out``.
 
-This package intentionally imports nothing at package level so that
-``stencil_tpu.obs.watchdog`` stays stdlib-weight when loaded directly.
+This package intentionally imports nothing at package level so that the
+stdlib-weight modules stay loadable directly.
 """
 
-__all__ = ["telemetry", "watchdog"]
+__all__ = ["telemetry", "watchdog", "ledger", "trace_export"]

@@ -1,0 +1,418 @@
+"""perf_tool tests: trend across rounds, the regression sentinel tripping
+and passing in BOTH directions (throughput legs trip low, seconds legs
+trip high), per-leg threshold config, legacy ingest over the committed
+BENCH_r0*/MULTICHIP_r0* shapes, and the committed LEDGER.jsonl
+acceptance pin (the r05 flagship renders with its round label)."""
+
+import json
+import os
+
+import pytest
+
+from stencil_tpu.apps import perf_tool
+from stencil_tpu.obs import ledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _seed(path, metric, values, labels=None, unit=None, direction_cfg=None):
+    es = []
+    for i, v in enumerate(values):
+        lbl = labels[i] if labels else f"h{i:02d}"
+        es.append(ledger.make_entry(metric, v, label=lbl, unit=unit,
+                                    platform="cpu", config={"c": 1}))
+    ledger.append_entries(path, es)
+
+
+# -- sentinel -----------------------------------------------------------------
+
+
+def test_gate_trips_low_on_throughput_leg(tmp_path, capsys):
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg_gb_per_s", [10.0, 10.4, 9.8])
+    _seed(led, "leg_gb_per_s", [5.0], labels=["new"])
+    rc = perf_tool.main(["gate", "--ledger", led, "--metric", "leg_gb_per_s",
+                         "--label", "new", "--rel-tol", "0.2"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "GATE FAIL leg_gb_per_s" in out and "below" in out
+    # ...but an IMPROVEMENT on a higher-is-better leg never trips
+    _seed(led, "leg_gb_per_s", [20.0], labels=["fast"])
+    rc = perf_tool.main(["gate", "--ledger", led, "--metric", "leg_gb_per_s",
+                         "--label", "fast", "--rel-tol", "0.2"])
+    assert rc == 0
+
+
+def test_gate_trips_high_on_seconds_leg(tmp_path, capsys):
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "loop_wall_s", [1.0, 1.05, 0.97], unit="s")
+    _seed(led, "loop_wall_s", [4.0], labels=["new"], unit="s")
+    rc = perf_tool.main(["gate", "--ledger", led, "--metric", "loop_wall_s",
+                         "--label", "new", "--rel-tol", "0.2"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "GATE FAIL loop_wall_s" in out and "above" in out
+    # a faster run on a lower-is-better leg passes
+    _seed(led, "loop_wall_s", [0.5], labels=["fast"], unit="s")
+    rc = perf_tool.main(["gate", "--ledger", led, "--metric", "loop_wall_s",
+                         "--label", "fast", "--rel-tol", "0.2"])
+    assert rc == 0
+
+
+def test_gate_passes_within_band_and_skips_thin_history(tmp_path, capsys):
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg_gb_per_s", [10.0, 10.4, 9.8])
+    _seed(led, "leg_gb_per_s", [10.1], labels=["new"])
+    rc = perf_tool.main(["gate", "--ledger", led, "--metric", "leg_gb_per_s",
+                         "--label", "new", "--rel-tol", "0.2"])
+    assert rc == 0
+    assert "GATE PASS leg_gb_per_s" in capsys.readouterr().out
+    # a leg with no history is a SKIP, and judging nothing exits 2
+    led2 = str(tmp_path / "L2.jsonl")
+    _seed(led2, "lonely", [1.0], labels=["only"])
+    rc = perf_tool.main(["gate", "--ledger", led2, "--metric", "lonely",
+                         "--label", "only"])
+    assert rc == 2
+    assert "SKIP" in capsys.readouterr().out
+
+
+def test_gate_mad_band_tighter_than_rel_tol():
+    # 3*MAD dominates when history is tight and rel_tol is 0
+    es = [ledger.make_entry("m", v, label=f"h{i}", platform="cpu",
+                            config={"c": 1})
+          for i, v in enumerate([10.0, 10.1, 9.9, 10.05])]
+    es.append(ledger.make_entry("m", 9.0, label="new", platform="cpu",
+                                config={"c": 1}))
+    verdicts = perf_tool.evaluate_gate(es, metrics=["m"], label="new",
+                                       rel_tol=0.0, mad_k=3.0)
+    assert verdicts[0]["status"] == "fail"
+    assert verdicts[0]["tol"] == pytest.approx(3.0 * ledger.mad(
+        [10.0, 10.1, 9.9, 10.05]))
+
+
+def test_gate_per_leg_config_overrides(tmp_path, capsys):
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg_gb_per_s", [10.0, 10.2], )
+    _seed(led, "leg_gb_per_s", [5.0], labels=["new"])
+    cfg = str(tmp_path / "legs.json")
+    # an explicit wide tolerance + direction=both for this leg
+    with open(cfg, "w") as f:
+        json.dump({"leg_gb_per_s": {"rel_tol": 0.9}}, f)
+    rc = perf_tool.main(["gate", "--ledger", led, "--metric", "leg_gb_per_s",
+                         "--label", "new", "--rel-tol", "0.1",
+                         "--leg-config", cfg])
+    assert rc == 0  # the per-leg override widened the band
+    with open(cfg, "w") as f:
+        json.dump({"*": {"direction": "both", "rel_tol": 0.05}}, f)
+    _seed(led, "leg_gb_per_s", [17.0], labels=["hot"])
+    rc = perf_tool.main(["gate", "--ledger", led, "--metric", "leg_gb_per_s",
+                         "--label", "hot", "--leg-config", cfg])
+    assert rc == 1  # direction=both: even an "improvement" out of band trips
+    capsys.readouterr()
+
+
+def test_default_direction_heuristic():
+    assert perf_tool.default_direction("exchange.gb_per_s", None) == "higher"
+    assert perf_tool.default_direction("jacobi.mcells_per_s_per_dev",
+                                       None) == "higher"
+    assert perf_tool.default_direction("jacobi.loop_wall_s", "s") == "lower"
+    assert perf_tool.default_direction("jacobi.iter_trimean_s",
+                                       None) == "lower"
+    assert perf_tool.default_direction("astaroth_512_iter_ms",
+                                       None) == "lower"
+    assert perf_tool.default_direction("bench.rc", "rc") == "lower"
+    # the report-style tag suffix does not confuse the lookup
+    assert perf_tool.default_direction("exchange.trimean_s[direct26]",
+                                       None) == "lower"
+
+
+# -- trend / diff / render ----------------------------------------------------
+
+
+def test_trend_and_diff(tmp_path, capsys):
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg", [10.0, 20.0], labels=["r01", "r02"], unit="GB/s")
+    rc = perf_tool.main(["trend", "--ledger", led])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "r01" in out and "r02" in out and "2.000x" in out
+    rc = perf_tool.main(["diff", "--ledger", led, "--a", "r01", "--b", "r02"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "2.000" in out
+
+
+def test_committed_ledger_renders_r05_flagship(capsys):
+    """The acceptance pin: the committed LEDGER.jsonl carries the real
+    r01->r05 trajectory, ending at the 83.1 Gcells/s round-5 flagship."""
+    led = os.path.join(REPO, "LEDGER.jsonl")
+    entries = ledger.load_ledger(led)  # schema-valid by construction
+    assert len(entries) >= 30
+    rc = perf_tool.main(["trend", "--ledger", led,
+                         "--metric", "jacobi3d_512_mcells_per_s_per_chip"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "r05" in out and "83059.7" in out  # = 83.1 Gcells/s
+    assert "r01" in out and "5395" in out     # the round-1 start
+    # the failed round 3 and the CPU-fallback round 4 are visible too
+    rc = perf_tool.main(["trend", "--ledger", led, "--metric", "bench.rc"])
+    out = capsys.readouterr().out
+    assert "r03" in out
+    rc = perf_tool.main(["trend", "--ledger", led,
+                         "--metric", "multichip_dryrun_ok"])
+    out = capsys.readouterr().out
+    assert "r02" in out and "r05" in out
+
+
+def test_render_dashboard(tmp_path, capsys):
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg_gb_per_s", [10.0, 10.3], labels=["r01", "r02"])
+    out_md = str(tmp_path / "dash.md")
+    rc = perf_tool.main(["render", "--ledger", led, "--out", out_md])
+    capsys.readouterr()
+    assert rc == 0
+    text = open(out_md).read()
+    assert "# Performance dashboard" in text
+    assert "## Latest" in text and "## Trends" in text
+    assert "leg_gb_per_s" in text
+
+
+# -- ingest CLI ---------------------------------------------------------------
+
+
+def test_ingest_legacy_files_idempotent(tmp_path, capsys):
+    led = str(tmp_path / "L.jsonl")
+    argv = ["ingest", "--ledger", led, "--legacy",
+            os.path.join(REPO, "BENCH_r05.json"),
+            os.path.join(REPO, "MULTICHIP_r05.json")]
+    assert perf_tool.main(argv) == 0
+    n1 = len(ledger.load_ledger(led))
+    assert n1 >= 8
+    assert perf_tool.main(argv) == 0  # re-ingest: nothing new
+    assert len(ledger.load_ledger(led)) == n1
+    capsys.readouterr()
+
+
+def test_ingest_metrics_jsonl(tmp_path, capsys):
+    import io
+
+    from stencil_tpu.obs import telemetry
+
+    buf = io.StringIO()
+    rec = telemetry.Recorder(sink=buf, app="t", run_id="RUN")
+    rec.meta("config", config={"x": 24})
+    for v in (1.0, 1.1, 0.9):
+        rec.gauge("leg.wall_s", v, unit="s")
+    m = tmp_path / "m.jsonl"
+    m.write_text(buf.getvalue())
+    led = str(tmp_path / "L.jsonl")
+    rc = perf_tool.main(["ingest", "--ledger", led, "--label", "run1",
+                         "--platform", "cpu", str(m)])
+    capsys.readouterr()
+    assert rc == 0
+    es = ledger.load_ledger(led)
+    assert es[0]["metric"] == "leg.wall_s" and es[0]["platform"] == "cpu"
+    # a schema-invalid metrics line fails the ingest loudly
+    m.write_text(buf.getvalue() + '{"v": 1}\n')
+    with pytest.raises(ValueError, match="missing required key"):
+        perf_tool.ingest_file(str(m), label="run2")
+
+
+def test_ingest_rejects_unknown_shape(tmp_path):
+    p = tmp_path / "odd.json"
+    p.write_text(json.dumps({"what": "is this"}))
+    with pytest.raises(ValueError, match="unrecognized payload shape"):
+        perf_tool.ingest_file(str(p))
+
+
+def test_ingest_single_line_metrics_jsonl(tmp_path, capsys):
+    """A metrics file with exactly ONE record parses as a single dict —
+    it must still route to the telemetry-JSONL path, not be rejected as
+    an unrecognized payload."""
+    m = tmp_path / "one.jsonl"
+    m.write_text(json.dumps(
+        {"v": 1, "run": "R", "proc": 0, "kind": "gauge", "name": "leg.s",
+         "t": 0.0, "value": 2.5, "unit": "s"}) + "\n")
+    es = perf_tool.ingest_file(str(m), label="run1", platform="cpu")
+    assert len(es) == 1
+    assert es[0]["metric"] == "leg.s" and es[0]["value"] == 2.5
+
+
+def test_backfilled_round_keeps_its_label_position(tmp_path, capsys):
+    """Groups order by (label, t), not ingest time: a round backfilled
+    AFTER later rounds (stamped with today's t) must not become the
+    trend's 'latest' nor the gate's default judged label."""
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg", [10.0, 30.0], labels=["r01", "r05"])
+    _seed(led, "leg", [20.0], labels=["r03"])  # backfill, newest t
+    gs = perf_tool.groups(ledger.load_ledger(led))
+    es = next(iter(gs.values()))
+    assert [e["label"] for e in es] == ["r01", "r03", "r05"]
+    # default gate label is the group's LAST label (r05), not r03
+    verdicts = perf_tool.evaluate_gate(ledger.load_ledger(led),
+                                       metrics=["leg"], rel_tol=9.0)
+    assert verdicts[0]["label"] == "r05"
+    rc = perf_tool.main(["trend", "--ledger", led])
+    out = capsys.readouterr().out
+    assert out.index("r03") < out.index("r05")
+
+
+def test_ingest_one_label_many_files_warns(tmp_path, capsys):
+    """Repeat runs of one config ingested under ONE label dedup to the
+    first file's value — the CLI must say so loudly."""
+    import io
+
+    from stencil_tpu.obs import telemetry
+
+    paths = []
+    for i, v in enumerate((1.0, 9.0)):
+        buf = io.StringIO()
+        rec = telemetry.Recorder(sink=buf, app="t", run_id=f"R{i}")
+        rec.gauge("leg.s", v, unit="s")
+        p = tmp_path / f"m{i}.jsonl"
+        p.write_text(buf.getvalue())
+        paths.append(str(p))
+    led = str(tmp_path / "L.jsonl")
+    assert perf_tool.main(["ingest", "--ledger", led, "--label", "day1",
+                           "--platform", "cpu"] + paths) == 0
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "day1" in err
+    # the dedup the warning describes: only the first file's value landed
+    es = [e for e in ledger.load_ledger(led) if e["metric"] == "leg.s"]
+    assert [e["value"] for e in es] == [1.0]
+
+
+def test_live_bench_label_orders_after_round_history(tmp_path, capsys):
+    """The documented auto-append flow: a default bench-<timestamp>
+    label must order AFTER the rNN prehistory (lexicographically it
+    sorts before "r01"), so the no-label gate judges the NEW round —
+    and trips on its regression — instead of re-judging r05."""
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg", [100.0, 110.0, 105.0], labels=["r01", "r02", "r05"])
+    _seed(led, "leg", [50.0], labels=["bench-20260803T120000"])
+    es = ledger.load_ledger(led)
+    ordered = next(iter(perf_tool.groups(es).values()))
+    assert [e["label"] for e in ordered][-1] == "bench-20260803T120000"
+    verdicts = perf_tool.evaluate_gate(es, metrics=["leg"], rel_tol=0.2)
+    assert verdicts[0]["label"] == "bench-20260803T120000"
+    assert verdicts[0]["status"] == "fail"  # the regression IS judged
+    rc = perf_tool.main(["trend", "--ledger", led])
+    out = capsys.readouterr().out
+    assert out.index("r05") < out.index("bench-20260803T120000")
+
+
+def test_read_subcommands_fail_on_missing_ledger(tmp_path, capsys):
+    """trend/diff/gate/render on a mistyped --ledger path must exit
+    nonzero, not render an empty artifact with rc 0."""
+    typo = str(tmp_path / "TYPO.jsonl")
+    for argv in (["trend", "--ledger", typo],
+                 ["diff", "--ledger", typo, "--a", "x", "--b", "y"],
+                 ["gate", "--ledger", typo],
+                 ["render", "--ledger", typo]):
+        assert perf_tool.main(argv) == 2
+        assert "no such ledger" in capsys.readouterr().err
+
+
+def test_outage_round_joins_platform_trend_group(tmp_path, capsys):
+    """The r03 discipline, end to end: an outage payload (no detail, so
+    platform 'unknown') must land INSIDE the real trajectory's trend
+    group — and trip the gate — not sit in an isolated single-entry
+    group nobody reads."""
+    led = str(tmp_path / "L.jsonl")
+    healthy = {"metric": "leg_mcells_per_s", "value": 100.0,
+               "detail": {"platform": "tpu", "size": 512}}
+    outage = {"metric": "leg_mcells_per_s", "value": 0.0,
+              "vs_baseline": 0.0,
+              "detail": {"error": "all bench children failed"}}
+    es = []
+    for i, p in enumerate((healthy, healthy, healthy)):
+        es += ledger.entries_from_bench_payload(p, label=f"r{i + 1:02d}")
+    es += ledger.entries_from_bench_payload(outage, label="r04")
+    ledger.append_entries(led, es)
+    gs = perf_tool.groups(ledger.load_ledger(led),
+                          metrics=["leg_mcells_per_s"])
+    assert len(gs) == 1, f"outage split the trend group: {list(gs)}"
+    (key, group), = gs.items()
+    assert key[1] == "tpu"
+    assert [e["label"] for e in group] == ["r01", "r02", "r03", "r04"]
+    # the trend renders the zero in the trajectory...
+    assert perf_tool.main(["trend", "--ledger", led,
+                           "--metric", "leg_mcells_per_s"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("leg_mcells_per_s ·") == 1 and "r04,0," in out
+    # ...and the newest-label gate trips on it by name
+    rc = perf_tool.main(["gate", "--ledger", led,
+                         "--metric", "leg_mcells_per_s"])
+    assert rc == 1
+    assert "GATE FAIL leg_mcells_per_s" in capsys.readouterr().out
+    # all-unknown metrics (the MULTICHIP docs) still stand alone
+    led2 = str(tmp_path / "L2.jsonl")
+    ledger.append_entries(led2, [
+        ledger.make_entry("multichip_dryrun_ok", 1.0, label="r02",
+                          platform="unknown", config={"n_devices": 8})])
+    gs2 = perf_tool.groups(ledger.load_ledger(led2))
+    assert list(gs2)[0][1] == "unknown"
+
+
+def test_label_from_filename_requires_round_form():
+    """Only the committed _rNN form names a round: a loose trailing
+    _<digits> (bench_128.json) must NOT become round 'r128' and displace
+    the real newest round in order_key's rNN prehistory."""
+    assert perf_tool._label_from_filename("BENCH_r03.json") == "r03"
+    assert perf_tool._label_from_filename("MULTICHIP_r05.json") == "r05"
+    assert perf_tool._label_from_filename("bench_128.json") is None
+    assert perf_tool._label_from_filename("payload.json") is None
+
+
+def test_platform_filter_keeps_all_unknown_metrics(tmp_path, capsys):
+    """A --platform filter must not silently un-judge metrics that exist
+    ONLY as platform-'unknown' (the MULTICHIP docs): with no platform-
+    tagged group to join, the unknown group stands alone even filtered."""
+    led = str(tmp_path / "L.jsonl")
+    ledger.append_entries(led, [
+        ledger.make_entry("multichip_dryrun_ok", float(v), label=f"r{i + 1:02d}",
+                          platform="unknown", config={"n_devices": 8})
+        for i, v in enumerate((1.0, 1.0, 1.0))])
+    gs = perf_tool.groups(ledger.load_ledger(led), platform="tpu")
+    (key,) = gs
+    assert key[:2] == ("multichip_dryrun_ok", "unknown")
+    assert len(next(iter(gs.values()))) == 3
+    rc = perf_tool.main(["trend", "--ledger", led, "--platform", "tpu"])
+    assert rc == 0
+    assert "multichip_dryrun_ok" in capsys.readouterr().out
+
+
+def test_markdown_flag_only_on_table_subcommands(tmp_path):
+    """gate output is line-oriented and render is unconditionally
+    markdown — neither accepts a dead --markdown flag."""
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg", [1.0, 1.0], labels=["r01", "r02"])
+    for argv in (["gate", "--ledger", led, "--markdown"],
+                 ["render", "--ledger", led, "--markdown"]):
+        with pytest.raises(SystemExit) as ei:
+            perf_tool.main(argv)
+        assert ei.value.code == 2
+    assert perf_tool.main(["trend", "--ledger", led, "--markdown"]) == 0
+    assert perf_tool.main(["diff", "--ledger", led, "--a", "r01", "--b", "r02",
+                           "--markdown"]) == 0
+
+
+def test_gate_bad_leg_config_is_usage_error_not_trip(tmp_path, capsys):
+    """A mistyped or malformed --leg-config must exit 2 with a message,
+    not escape as a traceback with rc 1 — CI would read that as a
+    regression trip."""
+    led = str(tmp_path / "L.jsonl")
+    _seed(led, "leg", [1.0, 1.0, 1.0])
+    for cfg in (str(tmp_path / "TYPO.json"),):
+        rc = perf_tool.main(["gate", "--ledger", led, "--leg-config", cfg])
+        assert rc == 2
+        assert "bad --leg-config" in capsys.readouterr().err
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    rc = perf_tool.main(["gate", "--ledger", led, "--leg-config", str(bad)])
+    assert rc == 2
+    assert "bad --leg-config" in capsys.readouterr().err
+    # a non-object config is the load_leg_config ValueError path
+    bad.write_text("[1, 2]")
+    rc = perf_tool.main(["gate", "--ledger", led, "--leg-config", str(bad)])
+    assert rc == 2
+    assert "bad --leg-config" in capsys.readouterr().err

@@ -239,3 +239,96 @@ def test_validate_record_fault_vocabulary():
     skip_bad = dict(skip)
     del skip_bad["reason"]
     assert any("reason" in e for e in telemetry.validate_record(skip_bad))
+
+
+def test_baseline_delta_flags_leaf_collisions(tmp_path):
+    """Two baseline keys sharing a leaf name make the leaf match
+    AMBIGUOUS: the row is flagged with both candidate keys instead of
+    silently ratio-ing against whichever flattened first; an exact
+    full-name match stays unambiguous."""
+    path = tmp_path / "m.jsonl"
+    base = {"v": 1, "run": "r1", "proc": 0, "t": 0.0}
+    rows = [
+        dict(base, kind="gauge", name="speed", value=2.0),
+        dict(base, kind="gauge", name="tpu.speed", value=3.0),
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    records, _ = report.load([str(path)])
+    agg = report.aggregate(records)
+    baseline = {"tpu": {"speed": 1.0}, "cpu": {"speed": 4.0}}
+    delta = report.baseline_delta(agg, baseline)
+    line = next(l for l in delta.splitlines() if l.startswith("speed,"))
+    assert "AMBIGUOUS" in line
+    assert "cpu.speed" in line and "tpu.speed" in line
+    # "tpu.speed" matches its full baseline key exactly: a clean ratio
+    exact = next(l for l in delta.splitlines() if l.startswith("tpu.speed"))
+    assert "AMBIGUOUS" not in exact and "3.000" in exact
+    # with one candidate the leaf match still resolves
+    single = report.baseline_delta(agg, {"cpu": {"speed": 4.0}})
+    assert "AMBIGUOUS" not in single and "0.500" in single
+
+
+def test_report_follow_single_pass(tmp_path, capsys):
+    """--follow smoke: one redraw renders the tables, reports heartbeat
+    freshness from the beat file's mtime, and waits politely for files
+    that do not exist yet."""
+    import io
+
+    path = tmp_path / "m.jsonl"
+    path.write_text(json.dumps(
+        {"v": 1, "run": "r", "proc": 0, "kind": "span", "name": "s",
+         "phase": "step", "t": 0.0, "seconds": 1.0}) + "\n")
+    hb = tmp_path / "beat"
+    hb.write_text("1\n")
+    out = io.StringIO()
+    rc = report.follow([str(path)], count=1, heartbeat=str(hb), out=out)
+    text = out.getvalue()
+    assert rc == 0
+    assert "follow #1" in text and "1/1 file(s)" in text
+    assert "s,step,1," in text  # the span table rendered
+    assert "heartbeat:" in text and "s ago" in text
+    # a not-yet-existing file is waited for, not an error
+    out2 = io.StringIO()
+    rc = report.follow([str(tmp_path / "later.jsonl")], count=1, out=out2)
+    assert rc == 0
+    assert "waiting for records" in out2.getvalue()
+    assert "no heartbeat file" in out2.getvalue()
+    # the CLI path: --follow --follow-count 1
+    assert report.main([str(path), "--follow", "--follow-count", "1"]) == 0
+    assert "follow #1" in capsys.readouterr().out
+
+
+def test_follow_survives_vanishing_file(tmp_path, monkeypatch):
+    """A metrics file can vanish between follow()'s exists() filter and
+    load()'s open() (watchdog ladders rotate child logs) — the live view
+    must render a waiting line, not die with a traceback."""
+    import io
+
+    path = tmp_path / "m.jsonl"
+    path.write_text("")
+    real_load = report.load
+
+    def racy_load(paths):
+        raise FileNotFoundError(f"[Errno 2] No such file: {paths}")
+
+    monkeypatch.setattr(report, "load", racy_load)
+    out = io.StringIO()
+    assert report.follow([str(path)], count=1, out=out) == 0
+    text = out.getvalue()
+    assert "waiting for records" in text and "1 schema error(s)" in text
+    monkeypatch.setattr(report, "load", real_load)
+
+
+def test_report_warns_ledger_without_validate(tmp_path, capsys):
+    """--ledger is a --validate-mode input; default report mode must say
+    it is ignoring the flag instead of skipping the ledger check with
+    rc 0 and no hint."""
+    path = tmp_path / "m.jsonl"
+    path.write_text(json.dumps(
+        {"v": 1, "run": "r", "proc": 0, "kind": "gauge", "name": "g",
+         "t": 0.0, "value": 1.0}) + "\n")
+    led = tmp_path / "L.jsonl"
+    led.write_text("")
+    assert report.main([str(path), "--ledger", str(led)]) == 0
+    err = capsys.readouterr().err
+    assert "ignores --ledger" in err

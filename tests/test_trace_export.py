@@ -1,0 +1,201 @@
+"""Trace timeline export tests: telemetry records -> Chrome-trace JSON
+with per-(run, proc) lanes, span duration events, counter tracks, and
+fault/checkpoint instant markers; the validator catches unsorted
+timestamps, incomplete X events, and unbalanced B/E pairs; the
+``report --trace-out`` / ``--validate --ledger`` CLI paths."""
+
+import json
+import os
+
+import pytest
+
+from stencil_tpu.apps import report
+from stencil_tpu.obs import ledger, trace_export
+
+
+def _rec(kind, name, t, run="R1", proc=0, **fields):
+    r = {"v": 1, "run": run, "proc": proc, "kind": kind, "name": name,
+         "t": t}
+    r.update(fields)
+    return r
+
+
+def _fault_run_records():
+    """A ci_fault_gate-style story: two runs, two procs, step spans,
+    an injected fault, the rollback, and checkpoint saves."""
+    return [
+        _rec("meta", "config", 100.0, app="jacobi3d", config={"x": 24}),
+        _rec("span", "jacobi.step", 101.0, seconds=1.0, phase="step",
+             app="jacobi3d"),
+        _rec("span", "jacobi.step", 101.5, seconds=0.5, phase="step",
+             proc=1),
+        _rec("counter", "fault.injected", 101.6, value=1, step=3,
+             fault_kind="nan"),
+        _rec("span", "health.check", 101.7, seconds=0.05, phase="health",
+             step=4),
+        _rec("counter", "recover.rollback", 102.0, value=1, from_step=4,
+             to_step=2, fault_step=3),
+        _rec("span", "ckpt.save", 102.5, seconds=0.3, phase="ckpt",
+             step=4),
+        _rec("gauge", "jacobi.mcells_per_s", 103.0, value=42.0),
+        _rec("heartbeat", "hb", 103.5, seq=7),
+        # a second run shares the timeline but gets its own pid
+        _rec("span", "jacobi.step", 104.0, seconds=0.8, run="R2"),
+    ]
+
+
+def test_to_trace_lanes_markers_and_sorting():
+    tr = trace_export.to_trace(_fault_run_records())
+    assert trace_export.validate_trace(tr) == []
+    ev = tr["traceEvents"]
+    # one process lane per run (named), one thread lane per (run, proc)
+    pnames = {e["args"]["name"] for e in ev
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert pnames == {"run R1 (jacobi3d)", "run R2"}
+    tnames = [(e["pid"], e["tid"]) for e in ev
+              if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert len(tnames) == 3  # R1/proc0, R1/proc1, R2/proc0
+    # spans are complete X events whose start is t - seconds
+    steps = [e for e in ev if e["ph"] == "X" and e["name"] == "jacobi.step"]
+    assert len(steps) == 3
+    first = min(steps, key=lambda e: e["ts"])
+    assert first["ts"] == 0.0  # earliest start anchors the timeline
+    assert first["dur"] == pytest.approx(1.0e6)
+    # fault/rollback/ckpt land as instant markers (ph "i")
+    inst = {e["name"] for e in ev if e["ph"] == "i"}
+    assert {"fault.injected", "recover.rollback", "ckpt.save"} <= inst
+    # the ckpt.save span ALSO keeps its duration event
+    assert any(e["ph"] == "X" and e["name"] == "ckpt.save" for e in ev)
+    # gauges/counters/heartbeats become counter tracks
+    cnames = {e["name"] for e in ev if e["ph"] == "C"}
+    assert {"jacobi.mcells_per_s", "heartbeat", "fault.injected"} <= cnames
+    # non-meta events are globally ts-sorted with non-negative stamps
+    ts = [e["ts"] for e in ev if e["ph"] != "M"]
+    assert ts == sorted(ts) and min(ts) >= 0
+    # args keep the provenance the timeline needs at hover
+    mark = next(e for e in ev if e["ph"] == "i"
+                and e["name"] == "fault.injected")
+    assert mark["args"]["step"] == 3 and mark["args"]["t"] == 101.6
+
+
+def test_validate_trace_catches_violations():
+    assert trace_export.validate_trace([]) != []
+    assert trace_export.validate_trace({"traceEvents": "nope"}) != []
+    base = {"pid": 1, "tid": 0, "name": "e"}
+    # unsorted timestamps
+    errs = trace_export.validate_trace({"traceEvents": [
+        dict(base, ph="i", s="p", ts=5.0), dict(base, ph="i", s="p", ts=1.0),
+    ]})
+    assert any("not sorted" in e for e in errs)
+    # X without dur / negative dur
+    errs = trace_export.validate_trace(
+        {"traceEvents": [dict(base, ph="X", ts=0.0)]})
+    assert any("dur" in e for e in errs)
+    errs = trace_export.validate_trace(
+        {"traceEvents": [dict(base, ph="X", ts=0.0, dur=-1.0)]})
+    assert any("dur" in e for e in errs)
+    # E without B, and an unclosed B — per lane
+    errs = trace_export.validate_trace(
+        {"traceEvents": [dict(base, ph="E", ts=0.0)]})
+    assert any("E without matching B" in e for e in errs)
+    errs = trace_export.validate_trace(
+        {"traceEvents": [dict(base, ph="B", ts=0.0)]})
+    assert any("unclosed B" in e for e in errs)
+    # balanced B/E on one lane is fine even with an X on another
+    assert trace_export.validate_trace({"traceEvents": [
+        dict(base, ph="B", ts=0.0), dict(base, ph="E", ts=1.0),
+        {"pid": 2, "tid": 0, "name": "x", "ph": "X", "ts": 2.0, "dur": 1.0},
+    ]}) == []
+    # unsupported phase, missing name, negative ts
+    assert trace_export.validate_trace(
+        {"traceEvents": [dict(base, ph="Z", ts=0.0)]})
+    assert trace_export.validate_trace(
+        {"traceEvents": [{"pid": 1, "tid": 0, "ph": "i", "ts": 0.0}]})
+    assert trace_export.validate_trace(
+        {"traceEvents": [dict(base, ph="i", ts=-3.0)]})
+
+
+def test_write_trace_roundtrip_and_refusal(tmp_path):
+    out = str(tmp_path / "trace.json")
+    n = trace_export.write_trace(out, _fault_run_records())
+    with open(out) as f:
+        tr = json.load(f)
+    assert len(tr["traceEvents"]) == n
+    assert trace_export.validate_trace(tr) == []
+    assert tr["displayTimeUnit"] == "ms"
+    # a span with negative seconds lowers to a negative-dur X event —
+    # the writer must refuse its own invalid output, not persist it
+    bad = [_rec("span", "s", 10.0, seconds=-1.0)]
+    with pytest.raises(ValueError, match="refusing"):
+        trace_export.write_trace(str(tmp_path / "bad.json"), bad)
+    assert not (tmp_path / "bad.json").exists()
+
+
+def test_report_trace_out_cli(tmp_path, capsys):
+    m = tmp_path / "m.jsonl"
+    m.write_text("\n".join(json.dumps(r) for r in _fault_run_records())
+                 + "\n")
+    out = str(tmp_path / "trace.json")
+    assert report.main([str(m), "--trace-out", out]) == 0
+    assert "trace:" in capsys.readouterr().out
+    with open(out) as f:
+        tr = json.load(f)
+    assert trace_export.validate_trace(tr) == []
+    assert any(e.get("ph") == "i" and e["name"] == "fault.injected"
+               for e in tr["traceEvents"])
+
+
+def test_report_validate_extends_to_ledger(tmp_path, capsys):
+    m = tmp_path / "m.jsonl"
+    m.write_text(json.dumps(
+        {"v": 1, "run": "r", "proc": 0, "kind": "gauge", "name": "g",
+         "t": 0.0, "value": 1.0}) + "\n")
+    led = str(tmp_path / "L.jsonl")
+    ledger.append_entries(led, [ledger.make_entry(
+        "leg", 1.0, label="r01", platform="cpu", config={"c": 1})])
+    assert report.main([str(m), "--validate", "--ledger", led]) == 0
+    assert "1 valid entries" in capsys.readouterr().out
+    with open(led, "a") as f:
+        f.write("{torn\n")
+    assert report.main([str(m), "--validate", "--ledger", led]) == 1
+    assert "LEDGER" in capsys.readouterr().out
+
+
+def test_report_validate_missing_ledger_fails(tmp_path, capsys):
+    """--validate --ledger with a nonexistent path must fail the gate —
+    a typo'd ledger path silently validating nothing is how schema
+    gates rot."""
+    m = tmp_path / "m.jsonl"
+    m.write_text(json.dumps(
+        {"v": 1, "run": "r", "proc": 0, "kind": "gauge", "name": "g",
+         "t": 0.0, "value": 1.0}) + "\n")
+    rc = report.main([str(m), "--validate",
+                      "--ledger", str(tmp_path / "TYPO.jsonl")])
+    assert rc == 1
+    assert "no such ledger file" in capsys.readouterr().out
+
+
+def test_write_trace_refuses_non_strict_json(tmp_path):
+    """A NaN gauge value must fail the export, not produce a file
+    Perfetto/chrome://tracing cannot parse (strict-JSON contract)."""
+    recs = [_rec("gauge", "g", 1.0, value=float("nan"))]
+    with pytest.raises(ValueError, match="non-strict-JSON"):
+        trace_export.write_trace(str(tmp_path / "nan.json"), recs)
+    assert not (tmp_path / "nan.json").exists()
+
+
+def test_report_mode_flags_warn_when_ignored(tmp_path, capsys):
+    """--validate/--follow are single-purpose modes: combining them with
+    --trace-out etc. says so on stderr instead of silently producing no
+    artifact."""
+    m = tmp_path / "m.jsonl"
+    m.write_text(json.dumps(
+        {"v": 1, "run": "r", "proc": 0, "kind": "gauge", "name": "g",
+         "t": 0.0, "value": 1.0}) + "\n")
+    t = str(tmp_path / "t.json")
+    assert report.main([str(m), "--validate", "--trace-out", t]) == 0
+    assert "--validate mode ignores --trace-out" in capsys.readouterr().err
+    assert not os.path.exists(t)
+    assert report.main([str(m), "--follow", "--follow-count", "1",
+                        "--trace-out", t]) == 0
+    assert "--follow mode ignores --trace-out" in capsys.readouterr().err
