@@ -264,6 +264,51 @@ def _child_main(mode: str, resume: bool = False) -> int:
         except Exception as e:
             errors["plan_autotune"] = f"{type(e).__name__}: {e}"[:400]
 
+    # multi-tenant campaign A/B (ROADMAP #4): B=64 independent 32^3
+    # tenants served as ONE batched compiled program (batch axis sharded
+    # over the mesh, zero collectives, per-tenant self-wrap halos) vs the
+    # same 64 tenants run sequentially through the standard single-domain
+    # machinery on the same devices — the tracked
+    # campaign_batched_over_sequential ratio (> 1: batching wins) with
+    # p50/p99 per-tenant step latency for the tail story.
+    camp_b = camp_s = 0.0
+    camp_p50 = camp_p99 = None
+    if leg("multi-tenant campaign (B=64 32^3 A/B)"):
+        try:
+            import tempfile as _tf
+
+            from stencil_tpu.campaign import (CampaignDriver, TenantJob,
+                                              run_sequential)
+
+            ndevc = 8 if len(jax.devices()) >= 8 else 1
+            camp_B, camp_n, camp_steps = 64, 32, 6
+            jobs = [TenantJob(f"t{i}", (camp_n, camp_n, camp_n), camp_steps,
+                              "float32", seed=i) for i in range(camp_B)]
+            camp_dir = os.environ.get("STENCIL_BENCH_CKPT_DIR") or None
+            if camp_dir:
+                # per-config subdir isolation (the headline-leg rule): a
+                # CPU-fallback campaign must never repoint or prune an
+                # accel campaign's per-tenant snapshots
+                camp_dir = os.path.join(camp_dir, f"campaign{camp_B}x{camp_n}")
+            else:
+                camp_dir = _tf.mkdtemp(prefix="bench-campaign-")
+            seq = run_sequential(jobs, devices=jax.devices()[:ndevc],
+                                 chunk=3)
+            bat = CampaignDriver(jobs, camp_B, camp_dir,
+                                 devices=jax.devices()[:ndevc],
+                                 chunk=3).run()
+            import math as _math
+
+            camp_b = bat["aggregate_mcells_per_s"]
+            camp_s = seq["aggregate_mcells_per_s"]
+            camp_p50, camp_p99 = bat["p50_step_s"], bat["p99_step_s"]
+            if not _math.isfinite(camp_p50):
+                camp_p50 = None  # a latency-less run must stay strict JSON
+            if camp_p99 is not None and not _math.isfinite(camp_p99):
+                camp_p99 = None
+        except Exception as e:
+            errors["campaign"] = f"{type(e).__name__}: {e}"[:400]
+
     # astaroth flagship details (BASELINE configs 4/4b): 8 fp32 fields,
     # fused Pallas RK3 substeps; skipped off-accelerator, via
     # STENCIL_BENCH_FAST=1, or when over budget (the three sliding-window
@@ -349,6 +394,21 @@ def _child_main(mode: str, resume: bool = False) -> int:
             if plan_default_gb_s else 0.0
         ),
         "plan_choice": plan_label,
+        # multi-tenant campaign leg: one batched program serving B=64
+        # 32^3 tenants over the sequential baseline (> 1: batching wins),
+        # with the per-tenant step-latency tail (utils/statistics
+        # percentiles) the serving story is judged on
+        "campaign_batched_mcells_per_s": round(camp_b, 2),
+        "campaign_sequential_mcells_per_s": round(camp_s, 2),
+        "campaign_batched_over_sequential": (
+            round(camp_b / camp_s, 3) if camp_s else 0.0
+        ),
+        "campaign_p50_step_s": (
+            round(camp_p50, 6) if camp_p50 is not None else None
+        ),
+        "campaign_p99_step_s": (
+            round(camp_p99, 6) if camp_p99 is not None else None
+        ),
         "astaroth_256_iter_ms": asta_ms,
         "astaroth_512_iter_ms": asta512_ms,
         "jacobi3d_768_mcells_per_s": jac768,

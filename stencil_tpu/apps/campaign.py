@@ -1,0 +1,227 @@
+"""campaign — multi-tenant batched serving of many small domains.
+
+The CLI over ``stencil_tpu/campaign/``: queue N tenant jobs (independent
+periodic jacobi boxes, seeded per-tenant initial fields), serve them in
+fixed-size batch slots under one compiled program per shape bucket
+(``--mode batched``), one at a time through the standard single-domain
+machinery (``--mode sequential``), or both back-to-back with the
+tracked ratio and an optional bit-parity check (``--mode ab`` — the
+``campaign_batched_over_sequential`` bench leg and the CI campaign
+gate's harness).
+
+Prints ONE JSON summary line (aggregate Mcells/s, p50/p99 per-tenant
+step latency, evictions, compile-cache hits) and records the same as
+gauges when ``--metrics-out`` is set:
+
+- ``campaign.batched_mcells_per_s`` / ``campaign.sequential_mcells_per_s``
+- ``campaign.batched_p50_step_s`` / ``..._p99_step_s`` (+ sequential)
+- ``campaign.batched_over_sequential`` (ab mode; > 1 = batching wins)
+
+Fault handling rides the driver: ``--inject nan@3:tenant=t2:repeat=always``
+drives one tenant to the rc-43 ``fault`` outcome — it is evicted (its
+lane backfilled from the queue) while its siblings keep stepping, and
+its evidence bundle + last-healthy snapshot land under
+``<campaign-dir>/tenants/t2/``.
+
+Usage: python -m stencil_tpu.apps.campaign --cpu 8 --tenants 8 --slot 4 \
+           --size 16 --steps 6 --mode ab --check-parity
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ..obs import telemetry
+from ..utils import logging as log
+
+
+def _finite_gauge(rec, name: str, value: float, **tags) -> None:
+    if value is not None and math.isfinite(value):
+        rec.gauge(name, value, **tags)
+
+
+def _round6(value: float):
+    """None for a non-finite sample (a latency-less run — e.g. 0 steps
+    or everything revived-complete) so the one-line summary stays strict
+    JSON: ``json.dumps`` would happily emit a bare ``NaN`` token."""
+    return round(value, 6) if math.isfinite(value) else None
+
+
+def build_jobs(args) -> list:
+    from ..campaign import TenantJob
+
+    return [
+        TenantJob(f"t{i}", (args.size, args.size, args.size), args.steps,
+                  args.dtype, seed=args.init_seed + i)
+        for i in range(args.tenants)
+    ]
+
+
+def run_modes(args, campaign_dir: str) -> dict:
+    from ..campaign import CampaignDriver, CompileCache, run_sequential
+
+    devices = jax.devices()[: args.cpu] if args.cpu else jax.devices()
+    jobs = build_jobs(args)
+    rec = telemetry.get()
+    out: dict = {
+        "app": "campaign",
+        "mode": args.mode,
+        "tenants": args.tenants,
+        "slot": args.slot,
+        "size": args.size,
+        "steps": args.steps,
+        "dtype": args.dtype,
+        "devices": len(devices),
+        "campaign_dir": campaign_dir,
+    }
+
+    seq = None
+    if args.mode in ("sequential", "ab"):
+        seq = run_sequential(jobs, devices=devices, chunk=args.chunk)
+        out["sequential_mcells_per_s"] = round(
+            seq["aggregate_mcells_per_s"], 3)
+        out["sequential_p50_step_s"] = _round6(seq["p50_step_s"])
+        out["sequential_p99_step_s"] = _round6(seq["p99_step_s"])
+        _finite_gauge(rec, "campaign.sequential_mcells_per_s",
+                      seq["aggregate_mcells_per_s"], phase="step")
+        _finite_gauge(rec, "campaign.sequential_p50_step_s",
+                      seq["p50_step_s"], phase="step", unit="s")
+        _finite_gauge(rec, "campaign.sequential_p99_step_s",
+                      seq["p99_step_s"], phase="step", unit="s")
+
+    bat = None
+    if args.mode in ("batched", "ab"):
+        cache = CompileCache()
+        drv = CampaignDriver(
+            jobs, args.slot, campaign_dir,
+            devices=devices, chunk=args.chunk,
+            ckpt_every=args.ckpt_every, ckpt_keep=args.ckpt_keep,
+            health_every=args.health_every, max_abs=args.max_abs or None,
+            max_rollbacks=args.max_rollbacks,
+            rollback_backoff=args.rollback_backoff,
+            inject=args.inject or None, inject_seed=args.inject_seed,
+            resume=args.resume, cache=cache, use_pallas=args.use_pallas,
+        )
+        bat = drv.run()
+        out["batched_mcells_per_s"] = round(
+            bat["aggregate_mcells_per_s"], 3)
+        out["batched_p50_step_s"] = _round6(bat["p50_step_s"])
+        out["batched_p99_step_s"] = _round6(bat["p99_step_s"])
+        out["slots"] = bat["slots"]
+        out["evicted"] = bat["evicted"]
+        out["cache"] = bat["cache"]
+        _finite_gauge(rec, "campaign.batched_mcells_per_s",
+                      bat["aggregate_mcells_per_s"], phase="step")
+        _finite_gauge(rec, "campaign.batched_p50_step_s",
+                      bat["p50_step_s"], phase="step", unit="s")
+        _finite_gauge(rec, "campaign.batched_p99_step_s",
+                      bat["p99_step_s"], phase="step", unit="s")
+
+    if args.mode == "ab":
+        ratio = (bat["aggregate_mcells_per_s"]
+                 / seq["aggregate_mcells_per_s"]
+                 if seq["aggregate_mcells_per_s"] > 0 else 0.0)
+        out["batched_over_sequential"] = round(ratio, 3)
+        _finite_gauge(rec, "campaign.batched_over_sequential", ratio,
+                      phase="step")
+        if args.check_parity:
+            mismatches = []
+            for tid, br in bat["results"].items():
+                if br.outcome != "done":
+                    continue  # evicted tenants diverge by construction
+                sr = seq["results"].get(tid)
+                if sr is None or sr.final.tobytes() != br.final.tobytes():
+                    mismatches.append(tid)
+            out["parity"] = "ok" if not mismatches else "MISMATCH"
+            out["parity_mismatches"] = mismatches
+            if mismatches:
+                log.error(f"campaign: batched results differ from "
+                          f"sequential for {mismatches}")
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    from ..parallel.distributed import maybe_init_from_env
+    maybe_init_from_env()
+    p = argparse.ArgumentParser(
+        description="multi-tenant batched campaign driver")
+    p.add_argument("--tenants", type=int, default=8,
+                   help="number of queued tenant jobs")
+    p.add_argument("--slot", type=int, default=4,
+                   help="batch-slot size B: tenants stepped per compiled "
+                        "program (padded with dead tenants when the queue "
+                        "drains)")
+    p.add_argument("--size", type=int, default=16,
+                   help="per-tenant cubic domain edge")
+    p.add_argument("--steps", type=int, default=6,
+                   help="steps per tenant")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64"])
+    p.add_argument("--chunk", type=int, default=2,
+                   help="fused steps per dispatch")
+    p.add_argument("--mode", choices=["batched", "sequential", "ab"],
+                   default="batched",
+                   help="ab = sequential baseline then batched, with the "
+                        "campaign_batched_over_sequential ratio")
+    p.add_argument("--check-parity", action="store_true",
+                   help="(ab) exit 1 unless every completed tenant's final "
+                        "field is bit-identical between modes")
+    p.add_argument("--campaign-dir", default="",
+                   help="per-tenant durable state root (default: a fresh "
+                        "temp dir)")
+    p.add_argument("--ckpt-every", type=int, default=0,
+                   help="checkpoint every active lane every N slot steps "
+                        "(0 = only final/eviction snapshots)")
+    p.add_argument("--ckpt-keep", type=int, default=3)
+    p.add_argument("--resume", action="store_true",
+                   help="pack tenants from their newest valid snapshot "
+                        "(revives evicted tenants)")
+    p.add_argument("--health-every", type=int, default=0,
+                   help="per-lane health-check cadence in slot steps "
+                        "(default: every fused chunk)")
+    p.add_argument("--max-abs", type=float, default=0.0,
+                   help="divergence ceiling on max|u| (0 = none)")
+    p.add_argument("--max-rollbacks", type=int, default=2,
+                   help="rollbacks per faulting step before the tenant is "
+                        "EVICTED with the rc-43 evidence bundle")
+    p.add_argument("--rollback-backoff", type=float, default=0.05)
+    p.add_argument("--inject", default="",
+                   help="per-tenant fault spec, e.g. "
+                        "'nan@3:tenant=t2:repeat=always' (campaign/inject)")
+    p.add_argument("--inject-seed", type=int, default=None)
+    p.add_argument("--init-seed", type=int, default=0,
+                   help="tenant i's initial field is seeded init-seed + i")
+    p.add_argument("--use-pallas", action="store_true",
+                   help="batched Pallas fast path (TPU; aligned layout)")
+    p.add_argument("--cpu", type=int, default=0,
+                   help="force N virtual CPU devices")
+    from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
+    add_metrics_flags(p)
+    args = p.parse_args(argv)
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+    if args.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+    rec = start_metrics(args, "campaign")
+
+    campaign_dir = args.campaign_dir or tempfile.mkdtemp(prefix="campaign-")
+    out = run_modes(args, campaign_dir)
+    print(json.dumps(out, default=str))
+    finish_metrics(rec)
+    if out.get("parity") == "MISMATCH":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -78,9 +78,11 @@ def _agg_key(rec: dict) -> str:
     one name would mix timings and false-positive the DISAGREE flag. The
     ``batched`` tag splits the same way: a quantity-batching A/B run emits
     both legs' truths (e.g. ``exchange.permutes_per_quantity`` 6/Q vs 6),
-    and averaging them would read as neither."""
+    and averaging them would read as neither. ``mode`` is the campaign
+    A/B's tag (``campaign.step_latency_s`` carries batched AND sequential
+    samples in one ab run — a folded p99 would describe neither leg)."""
     name = rec["name"]
-    tags = [str(rec[t]) for t in ("method", "batched") if t in rec]
+    tags = [str(rec[t]) for t in ("method", "batched", "mode") if t in rec]
     if tags:
         return f"{name}[{','.join(tags)}]"
     return name
@@ -155,8 +157,13 @@ def _rows_to_table(header: List[str], rows: List[List[str]],
     return out
 
 
-def tables(agg: dict, markdown: bool = False) -> str:
-    """The human/CI-facing report: spans, counters, gauges."""
+def tables(agg: dict, markdown: bool = False, p99: bool = False) -> str:
+    """The human/CI-facing report: spans, counters, gauges.
+
+    ``p99`` adds a tail-latency column to the span tables (linear-
+    interpolated 99th percentile, utils/statistics.percentile) — central
+    tendency alone hides exactly what a multi-tenant latency story is
+    about."""
     lines: List[str] = []
     head = (
         f"{agg['n_records']} records · runs={len(agg['runs'])} "
@@ -168,13 +175,15 @@ def tables(agg: dict, markdown: bool = False) -> str:
         rows = [
             [name, agg["span_phase"].get(name, "-"), str(st.count()),
              f"{st.min():.6f}", f"{st.trimean():.6f}", f"{st.max():.6f}"]
+            + ([f"{st.percentile(99):.6f}"] if p99 else [])
             for name, st in sorted(agg["spans"].items())
         ]
         lines.append("" if markdown else "# spans")
         if markdown:
             lines.append("**spans**")
         lines += _rows_to_table(
-            ["span", "phase", "n", "min_s", "trimean_s", "max_s"],
+            ["span", "phase", "n", "min_s", "trimean_s", "max_s"]
+            + (["p99_s"] if p99 else []),
             rows, markdown)
 
     if agg["counters"]:
@@ -271,8 +280,8 @@ def _heartbeat_line(hb_path: Optional[str]) -> str:
 
 
 def follow(paths: List[str], *, interval_s: float = 2.0, count: int = 0,
-           markdown: bool = False, heartbeat: Optional[str] = None,
-           out=None) -> int:
+           markdown: bool = False, p99: bool = False,
+           heartbeat: Optional[str] = None, out=None) -> int:
     """Live tail: re-read the (growing) metrics files every
     ``interval_s`` and re-render the span/gauge tables in place.
 
@@ -296,7 +305,8 @@ def follow(paths: List[str], *, interval_s: float = 2.0, count: int = 0,
                 # (watchdog retry ladders rotate child logs) — wait for
                 # the next redraw instead of dying mid-view
                 records, errors = [], [str(e)]
-            body = (tables(aggregate(records), markdown=markdown) if records
+            body = (tables(aggregate(records), markdown=markdown, p99=p99)
+                    if records
                     else f"(waiting for records in {', '.join(paths)})")
             if getattr(out, "isatty", lambda: False)():
                 out.write("\x1b[2J\x1b[H")  # clear + home: render in place
@@ -320,6 +330,9 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("paths", nargs="+", help="metrics JSONL file(s)")
     p.add_argument("--markdown", action="store_true",
                    help="markdown tables instead of CSV")
+    p.add_argument("--p99", action="store_true",
+                   help="add a p99 tail-latency column to the span tables "
+                        "(the campaign latency legs' statistic)")
     p.add_argument("--baseline", default="",
                    help="JSON of recorded numbers for a vs-baseline delta")
     p.add_argument("--validate", action="store_true",
@@ -360,7 +373,7 @@ def main(argv: Optional[list] = None) -> int:
                                    ("--out", args.out)])
         return follow(args.paths, interval_s=args.interval,
                       count=args.follow_count, markdown=args.markdown,
-                      heartbeat=args.heartbeat or None)
+                      p99=args.p99, heartbeat=args.heartbeat or None)
     if args.validate:
         _warn_ignored("--validate", [("--trace-out", args.trace_out),
                                      ("--baseline", args.baseline),
@@ -404,7 +417,7 @@ def main(argv: Optional[list] = None) -> int:
         print(f"# trace: {n_ev} events -> {args.trace_out}")
 
     agg = aggregate(records)
-    text = tables(agg, markdown=args.markdown)
+    text = tables(agg, markdown=args.markdown, p99=args.p99)
     if args.baseline:
         with open(args.baseline) as f:
             text += "\n" + baseline_delta(agg, json.load(f),

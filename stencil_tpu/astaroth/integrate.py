@@ -472,3 +472,64 @@ def make_astaroth_step(
         check_vma=not interpret,
     )
     return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def make_batched_astaroth_step(spec, info: AcMeshInfo, dt: float = 1e-8,
+                               iters: int = 1, sharding=None):
+    """The multi-tenant batched astaroth iteration (XLA path):
+    ``fn(curr, out) -> (curr, out)`` over dicts of ``(B, pz, py, px)``
+    stacked tenant fields, each tenant an independent single-block
+    periodic MHD box.
+
+    ``spec`` describes ONE tenant (``GridSpec(size, Dim3(1, 1, 1),
+    Radius.constant(3))``); the leading batch axis stacks B tenants.
+    Per iteration the reference swap-per-iteration structure runs once:
+    the halo fill is the per-tenant periodic self-wrap
+    (ops/halo_fill.wrap_fill_batched — composed x->y->z order, so the
+    6th-order cross-stencils see edge/corner halos identical to a
+    single-block ``HaloExchange``), substep 0 integrates the full
+    compute region from the exchanged state, substeps 1-2 read the same
+    in buffers, and the buffers swap once. ``_integrate_region`` already
+    rides leading dims (its slices open with ``...``), so every lane is
+    bit-identical to the single-domain ``make_astaroth_step`` hoisted
+    overlap iteration (tests/test_campaign.py pins it).
+
+    ``sharding`` splits the batch axis over a 1-D device mesh — the
+    program has zero collectives, so one jit serves B tenants across the
+    whole mesh. Buffers are not donated (campaign stash semantics)."""
+    from ..geometry import Dim3 as _D3
+    from ..ops.halo_fill import wrap_fill_batched
+
+    r = spec.radius
+    assert spec.dim == _D3(1, 1, 1), (
+        f"batched tenants are single-block domains; got partition {spec.dim}"
+    )
+    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
+        "astaroth needs face radius >= 3 (6th-order stencils)"
+    )
+    inv_ds = (
+        info.real_params["AC_inv_dsx"],
+        info.real_params["AC_inv_dsy"],
+        info.real_params["AC_inv_dsz"],
+    )
+    c = Constants.from_info(info)
+    off = spec.compute_offset()
+    compute = Rect3(off, off + spec.base)
+
+    def iteration(curr, out):
+        curr = {k: wrap_fill_batched(spec, v) for k, v in curr.items()}
+        out = _integrate_region(0, compute, inv_ds, c, dt, curr, out)
+        for s in (1, 2):
+            out = _integrate_region(s, compute, inv_ds, c, dt, curr, out)
+        return out, curr  # one swap per iteration (astaroth.cu:642-648)
+
+    def entry_fn(curr, out):
+        if iters == 1:
+            return iteration(curr, out)
+        return lax.fori_loop(
+            0, iters, lambda _, co: iteration(co[0], co[1]), (curr, out))
+
+    if sharding is None:
+        return jax.jit(entry_fn)
+    sh = {k: sharding for k in FIELDS}
+    return jax.jit(entry_fn, in_shardings=(sh, sh), out_shardings=(sh, sh))

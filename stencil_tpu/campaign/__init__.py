@@ -1,0 +1,27 @@
+"""Multi-tenant batched campaigns (ROADMAP #4).
+
+One compiled program serving thousands of small independent domains:
+``driver.CampaignDriver`` packs queued tenant jobs into fixed-size batch
+slots, steps each slot as one ``(B, z, y, x)`` stacked program through
+``fault/recover.run_guarded`` (per-lane health, rc-43 eviction with
+backfill, per-tenant ckpt/ durable state), and ``compile_cache`` makes
+the one-program-many-slots economics measurable
+(``compile.cache_hit`` / ``compile.build_s``).
+
+The user-facing surface is ``apps/campaign.py`` and the tracked
+``campaign_batched_over_sequential`` bench leg.
+"""
+
+from .compile_cache import CompileCache, cache_key  # noqa: F401
+from .driver import (  # noqa: F401
+    CampaignDriver,
+    Lane,
+    TenantJob,
+    TenantResult,
+    batch_devices,
+    plan_slots,
+    run_sequential,
+    tenant_init_field,
+)
+from .health import SlotHealthGuard, TenantFault  # noqa: F401
+from .inject import SlotInjector  # noqa: F401

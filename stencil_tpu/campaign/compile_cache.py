@@ -1,0 +1,77 @@
+"""Shape-bucketed compile cache: the serving asset of the campaign layer.
+
+A multi-tenant campaign's economics hinge on one fact: the step program
+depends only on the SHAPE of the work — tenant grid, radius, dtype,
+batch size, fused-chunk length, device count — never on which tenants
+occupy the slot. The pjit mechanism behind this is the SNIPPETS.md note
+the ROADMAP cites: the mesh is resolved at call site, so one compiled
+program serves every same-shape slot. This cache makes that reuse
+explicit and MEASURABLE: every lookup records a ``compile.cache_hit``
+gauge (1/0) and every miss wraps its build in a ``compile.build`` span +
+``compile.build_s`` gauge, so "the second slot ran with zero
+recompilation" is a telemetry pin (CI: scripts/ci_campaign_gate.py;
+tests/test_campaign.py), not a hope.
+
+Keys are canonicalized exactly like the plan DB's problem key
+(``plan/ir.PlanConfig`` — grid, radius dirs, dtype multiset, ndev,
+platform; plan/db.py stores tuned plans under the same string), extended
+with the campaign-shape fields (batch size, chunk length, workload,
+partition). Two slots whose tenants differ but whose shapes agree map to
+the same key by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..obs import telemetry
+
+
+def cache_key(config, **extra) -> str:
+    """Canonical string key: a ``plan.ir.PlanConfig`` (the plan DB's
+    problem key) plus campaign-shape extras (``batch=``, ``chunk=``,
+    ``workload=``, ...). Sorted-key JSON, like ``PlanConfig.key()``."""
+    obj = dict(config.to_json())
+    obj.update(extra)
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+class CompileCache:
+    """In-process program cache with hit/build telemetry.
+
+    ``get(key, build)`` returns the cached program for ``key`` or builds
+    it via ``build()`` (recording the build wall as ``compile.build`` /
+    ``compile.build_s``). Either way a ``compile.cache_hit`` gauge lands,
+    so a metrics file states exactly how many programs a campaign
+    compiled and how many slots they served.
+    """
+
+    def __init__(self):
+        self._progs: Dict[str, Any] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._progs)
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "programs": len(self._progs)}
+
+    def get(self, key: str, build: Callable[[], Any]):
+        rec = telemetry.get()
+        hit = key in self._progs
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+            t0 = time.perf_counter()
+            with rec.span("compile.build", phase="compile", key=key):
+                self._progs[key] = build()
+            rec.gauge("compile.build_s", time.perf_counter() - t0,
+                      phase="compile", unit="s", key=key)
+        rec.gauge("compile.cache_hit", 1 if hit else 0, phase="compile",
+                  key=key)
+        return self._progs[key]

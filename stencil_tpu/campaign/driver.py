@@ -1,0 +1,647 @@
+"""Multi-tenant batched campaigns: one compiled program, thousands of
+small domains.
+
+Every other layer of this repo scales ONE big domain; production traffic
+from many users is the inverse workload — floods of small-to-medium
+*independent* simulations (ROADMAP #4). This driver serves that shape:
+
+- **Queue -> slots.** Tenant jobs queue FIFO; the driver packs them into
+  fixed-size batch slots of ``slot_size`` lanes, bucketed by shape
+  (grid, dtype): a slot's compiled program depends only on the bucket,
+  never on the tenants in it. When the queue drains below a full slot,
+  the empty lanes are DEAD tenants (zeros — finite, never attributed).
+- **Batched stepping.** A slot's state is one ``(B, pz, py, px)`` stacked
+  array sharded over a 1-D device mesh on the batch axis
+  (``ops/jacobi.make_batched_jacobi_loop``): each tenant is its own
+  periodic box (halos self-wrap per tenant, never across the batch
+  axis), the program has ZERO collectives, and one jit serves every
+  same-shape slot through the :class:`~.compile_cache.CompileCache`
+  (``compile.cache_hit`` / ``compile.build_s`` telemetry).
+- **Guarded slots.** Each slot segment runs through
+  ``fault/recover.run_guarded`` — the SAME engine the apps use — with a
+  per-lane :class:`~.health.SlotHealthGuard` and an optional per-tenant
+  :class:`~.inject.SlotInjector`. A transient fault rolls the whole slot
+  back to the last health-checked stash (deterministic recompute keeps
+  every lane bit-identical); a tenant that exhausts ``max_rollbacks``
+  raises through as the rc-43 ``fault`` outcome and is EVICTED: its
+  evidence bundle moves into its tenant dir, its last healthy state is
+  written as a revivable snapshot, its lane is backfilled from the queue
+  (or dies), and the surviving lanes resume from the stash — the slot
+  never stalls, and survivors finish bit-identical to an uninjected
+  campaign (tests/test_campaign.py, scripts/ci_campaign_gate.py).
+- **Per-tenant durable state.** Every tenant owns a snapshot dir
+  ``<campaign_dir>/tenants/<tid>`` (ckpt/ subsystem: crash-safe rename
+  protocol, manifests, retention). ``ckpt_every`` > 0 checkpoints every
+  active lane at the cadence; completion and eviction always persist a
+  final/last-healthy snapshot, so evicted tenants are revivable
+  (``resume=True`` packs a tenant from its newest valid snapshot).
+
+The sequential baseline (:func:`run_sequential`) serves the same jobs
+one tenant at a time through the standard ``DistributedDomain`` +
+``make_jacobi_loop`` machinery on the same devices — the A/B behind the
+tracked ``campaign_batched_over_sequential`` bench leg (aggregate
+Mcells/s and p50/p99 per-tenant step latency, utils/statistics
+percentiles).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ckpt import assemble_global, check_compatible, find_resume, write_snapshot
+from ..domain.grid import GridSpec
+from ..fault import RecoveryExhausted, RecoveryPolicy, chunk_plan, run_guarded
+from ..fault.inject import FaultPlan
+from ..geometry import Dim3, Radius
+from ..obs import telemetry
+from ..obs.watchdog import FAULT_RC
+from ..ops.jacobi import INIT_TEMP, make_batched_jacobi_loop, sphere_sel
+from ..utils import logging as log
+from ..utils.statistics import percentile
+from ..utils.sync import hard_sync
+from .compile_cache import CompileCache, cache_key
+from .health import SlotHealthGuard, TenantFault
+from .inject import SlotInjector
+
+QUANTITY = "temperature"
+
+
+@dataclass
+class TenantJob:
+    """One queued simulation: an independent periodic jacobi box."""
+
+    tid: str
+    size: Tuple[int, int, int]      # (x, y, z)
+    steps: int
+    dtype: str = "float32"
+    seed: int = 0
+
+    def bucket(self) -> Tuple[Tuple[int, int, int], str]:
+        """The shape bucket: jobs in one slot must share it (the compiled
+        program and the compile-cache key depend on nothing else)."""
+        return (tuple(int(v) for v in self.size), str(self.dtype))
+
+
+@dataclass
+class TenantResult:
+    tid: str
+    outcome: str                    # "done" | "fault"
+    steps: int                      # tenant steps completed
+    snapshot_dir: str
+    evidence: Optional[str] = None
+    final: Optional[np.ndarray] = None   # global [z,y,x] interior ("done")
+
+
+@dataclass
+class Lane:
+    """One slot position: which tenant occupies it and the step anchors
+    mapping the slot clock to the tenant clock (backfilled lanes run
+    offset from the slot's step counter)."""
+
+    idx: int
+    tenant: Optional[TenantJob] = None
+    start_slot_step: int = 0
+    start_tenant_step: int = 0
+
+    def tenant_step(self, slot_step: int) -> int:
+        return self.start_tenant_step + (slot_step - self.start_slot_step)
+
+    def end_slot_step(self) -> int:
+        assert self.tenant is not None
+        return self.start_slot_step + (self.tenant.steps
+                                       - self.start_tenant_step)
+
+
+def tenant_init_field(job: TenantJob) -> np.ndarray:
+    """The ONE authority for a tenant's initial temperature field
+    (``[z, y, x]``): the jacobi lukewarm baseline plus a seeded
+    perturbation so tenants are distinguishable — the driver, the
+    sequential baseline, revival, and the parity tests all regenerate a
+    tenant's step-0 state from this."""
+    x, y, z = job.size
+    rng = np.random.RandomState(job.seed & 0x7FFFFFFF)
+    f = INIT_TEMP + 0.05 * rng.standard_normal((z, y, x))
+    return f.astype(job.dtype)
+
+
+def pick_slot(queue: deque,
+              slot_size: int) -> Tuple[Tuple, List[TenantJob], deque]:
+    """Pop the next slot's jobs: the queue head's bucket, same-bucket
+    jobs pulled forward FIFO until the slot fills. Returns ``(bucket,
+    picked, remaining-queue)`` — the ONE packing policy, shared by the
+    driver and the :func:`plan_slots` preview."""
+    bucket = queue[0].bucket()
+    picked: List[TenantJob] = []
+    rest: List[TenantJob] = []
+    for j in queue:
+        if j.bucket() == bucket and len(picked) < slot_size:
+            picked.append(j)
+        else:
+            rest.append(j)
+    return bucket, picked, deque(rest)
+
+
+def plan_slots(jobs: Sequence[TenantJob],
+               slot_size: int) -> List[Tuple[Tuple, List[str]]]:
+    """Deterministic packing preview: ``[(bucket, [tids...]), ...]`` in
+    the order the driver forms slots (:func:`pick_slot`). Pure (no
+    devices, no state): the packing-determinism pin of
+    tests/test_campaign.py."""
+    queue = deque(jobs)
+    out: List[Tuple[Tuple, List[str]]] = []
+    while queue:
+        bucket, picked, queue = pick_slot(queue, slot_size)
+        out.append((bucket, [j.tid for j in picked]))
+    return out
+
+
+def batch_devices(slot_size: int, devices: Sequence) -> List:
+    """The largest device prefix that divides the batch axis evenly."""
+    for n in range(min(slot_size, len(devices)), 0, -1):
+        if slot_size % n == 0:
+            return list(devices[:n])
+    return list(devices[:1])
+
+
+class CampaignDriver:
+    """Serve a queue of tenant jobs through fixed-size batch slots."""
+
+    def __init__(
+        self,
+        jobs: Sequence[TenantJob],
+        slot_size: int,
+        campaign_dir: str,
+        *,
+        devices: Optional[Sequence] = None,
+        radius: int = 1,
+        chunk: int = 2,
+        ckpt_every: int = 0,
+        ckpt_keep: int = 3,
+        health_every: int = 0,
+        max_abs: Optional[float] = None,
+        max_rollbacks: int = 2,
+        rollback_backoff: float = 0.05,
+        inject: Optional[str] = None,
+        inject_seed: Optional[int] = None,
+        resume: bool = False,
+        cache: Optional[CompileCache] = None,
+        use_pallas: bool = False,
+    ):
+        assert slot_size >= 1
+        tids = [j.tid for j in jobs]
+        assert len(set(tids)) == len(tids), "tenant ids must be unique"
+        self.jobs = list(jobs)
+        self.slot_size = int(slot_size)
+        self.campaign_dir = campaign_dir
+        self.devices = (list(devices) if devices is not None
+                        else jax.devices())
+        self.radius = int(radius)
+        self.chunk = max(1, int(chunk))
+        self.ckpt_every = int(ckpt_every)
+        self.ckpt_keep = int(ckpt_keep)
+        self.health_every = int(health_every) or self.chunk
+        self.max_abs = max_abs
+        self.policy = RecoveryPolicy(max_rollbacks=max_rollbacks,
+                                     backoff_s=rollback_backoff)
+        self.inject_spec = inject or None
+        self.inject_seed = inject_seed
+        self.resume = bool(resume)
+        self.cache = cache if cache is not None else CompileCache()
+        self.use_pallas = bool(use_pallas)
+
+    # -- per-tenant durable state ---------------------------------------------
+    def tenant_dir(self, tid: str) -> str:
+        return os.path.join(self.campaign_dir, "tenants", tid)
+
+    def _write_tenant_snapshot(self, job: TenantJob, spec: GridSpec,
+                               lane_state: np.ndarray, step: int) -> None:
+        p = spec.padded()
+        arr6 = np.ascontiguousarray(
+            lane_state.reshape(1, 1, 1, p.z, p.y, p.x))
+        write_snapshot(self.tenant_dir(job.tid), step, spec,
+                       {QUANTITY: arr6}, dtypes={QUANTITY: job.dtype},
+                       keep=self.ckpt_keep)
+
+    def _resume_tenant(self, job: TenantJob) -> Optional[Tuple[int, np.ndarray]]:
+        """The newest valid compatible snapshot of a revived tenant:
+        ``(tenant_step, global [z,y,x])`` or None (fresh start)."""
+        if not self.resume:
+            return None
+        x, y, z = job.size
+        found = find_resume(
+            self.tenant_dir(job.tid),
+            accept=lambda m: check_compatible(
+                m, Dim3(x, y, z), [QUANTITY], [job.dtype]),
+        )
+        if found is None:
+            return None
+        snap, manifest = found
+        g = assemble_global(snap, manifest, QUANTITY, dtype=job.dtype)
+        log.info(f"campaign: revived tenant {job.tid} from step "
+                 f"{manifest['step']} ({snap})")
+        return int(manifest["step"]), g
+
+    # -- compiled programs ----------------------------------------------------
+    def _loop(self, spec: GridSpec, bucket, iters: int, sharding,
+              sel_sharding, devs: Sequence):
+        from ..plan.ir import PlanConfig
+
+        (size, dtype) = bucket
+        cfg = PlanConfig.make(Dim3(*size), spec.radius, [dtype], len(devs),
+                              self.devices[0].platform)
+        # device IDENTITY joins the key, not just the count: the jitted
+        # loop's in_shardings pin a concrete mesh, and a shared cache
+        # serving two drivers on disjoint same-sized device sets must
+        # never hand one the other's program
+        key = cache_key(cfg, workload="jacobi-batched",
+                        batch=self.slot_size, iters=int(iters),
+                        pallas=self.use_pallas,
+                        devices=[d.id for d in devs])
+        return self.cache.get(key, lambda: make_batched_jacobi_loop(
+            spec, iters, sharding=sharding, sel_sharding=sel_sharding,
+            use_pallas=self.use_pallas,
+            batch=self.slot_size if self.use_pallas else None))
+
+    # -- the campaign ---------------------------------------------------------
+    def run(self) -> dict:
+        rec = telemetry.get()
+        os.makedirs(self.campaign_dir, exist_ok=True)
+        queue = deque(self.jobs)
+        results: Dict[str, TenantResult] = {}
+        lat: List[float] = []        # per-chunk per-step wall samples
+        cell_steps = 0
+        wall = 0.0
+        slot_idx = 0
+        t0 = time.perf_counter()
+        while queue:
+            bucket, picked, queue = pick_slot(queue, self.slot_size)
+            stats = self._run_slot(slot_idx, bucket, picked, queue, results)
+            lat.extend(stats["latency_samples"])
+            cell_steps += stats["cell_steps"]
+            wall += stats["wall_s"]
+            slot_idx += 1
+        agg = cell_steps / wall / 1e6 if wall > 0 else 0.0
+        summary = {
+            "results": results,
+            "tenants": len(self.jobs),
+            "slots": slot_idx,
+            "cell_steps": cell_steps,
+            "step_wall_s": wall,
+            "total_wall_s": time.perf_counter() - t0,
+            "aggregate_mcells_per_s": agg,
+            "p50_step_s": percentile(lat, 50) if lat else float("nan"),
+            "p99_step_s": percentile(lat, 99) if lat else float("nan"),
+            "evicted": sorted(t for t, r in results.items()
+                              if r.outcome == "fault"),
+            "cache": self.cache.stats(),
+        }
+        rec.meta("campaign.summary", slots=slot_idx,
+                 tenants=len(self.jobs), evicted=len(summary["evicted"]),
+                 cache_hits=self.cache.hits, cache_misses=self.cache.misses)
+        return summary
+
+    def _run_slot(self, slot_idx: int, bucket, initial: List[TenantJob],
+                  queue: deque, results: Dict[str, TenantResult]) -> dict:
+        rec = telemetry.get()
+        (size, dtype) = bucket
+        x, y, z = size
+        cells = x * y * z
+        spec = GridSpec(Dim3(x, y, z), Dim3(1, 1, 1),
+                        Radius.constant(self.radius),
+                        aligned=self.use_pallas)
+        p = spec.padded()
+        off = spec.compute_offset()
+        B = self.slot_size
+        devs = batch_devices(B, self.devices)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.asarray(devs), ("b",))
+        sh = NamedSharding(mesh, P("b"))
+        shr = NamedSharding(mesh, P())
+
+        # sel: the standard hot/cold spheres, shared across lanes (every
+        # tenant of one bucket sees the same geometry); the Pallas path
+        # wants the per-tenant stacked layout its kernel indexes
+        sel_np = np.zeros((p.z, p.y, p.x), np.int32)
+        sel_np[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x] = (
+            sphere_sel((x, y, z)))
+        if self.use_pallas:
+            sel = jax.device_put(
+                jnp.asarray(np.broadcast_to(sel_np, (B,) + sel_np.shape)
+                            .copy()), sh)
+            sel_sh = sh
+        else:
+            sel = jax.device_put(jnp.asarray(sel_np), shr)
+            sel_sh = shr
+
+        lanes = [Lane(i) for i in range(B)]
+
+        def lane_init(job: TenantJob) -> Tuple[int, np.ndarray]:
+            revived = self._resume_tenant(job)
+            t0_step, g = revived if revived is not None else (
+                0, tenant_init_field(job))
+            padded = np.zeros((p.z, p.y, p.x), dtype)
+            padded[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x] = g
+            return t0_step, padded
+
+        curr_np = np.zeros((B, p.z, p.y, p.x), dtype)
+        for i, job in enumerate(initial):
+            t0_step, padded = lane_init(job)
+            if t0_step >= job.steps:
+                # revived past its target: report done, leave the lane to
+                # a later backfill pass
+                g = padded[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x]
+                results[job.tid] = TenantResult(
+                    job.tid, "done", job.steps, self.tenant_dir(job.tid),
+                    final=np.ascontiguousarray(g))
+                continue
+            lanes[i].tenant = job
+            lanes[i].start_slot_step = 0
+            lanes[i].start_tenant_step = t0_step
+            curr_np[i] = padded
+        curr = jax.device_put(jnp.asarray(curr_np), sh)
+        nxt0 = jax.device_put(jnp.zeros_like(curr), sh)
+        del curr_np
+
+        guard = SlotHealthGuard(every=self.health_every, max_abs=self.max_abs)
+        guard.bind(
+            lambda lane: (lanes[lane].tenant.tid
+                          if lanes[lane].tenant is not None else None),
+            lambda lane, step: lanes[lane].tenant_step(step),
+        )
+        injector = None
+        if self.inject_spec:
+            plan = FaultPlan.from_spec(self.inject_spec,
+                                       seed=self.inject_seed)
+            if plan is not None:
+                injector = SlotInjector(plan, spec, lambda: lanes,
+                                        known_tenants=[j.tid
+                                                       for j in self.jobs])
+        rec.meta("campaign.slot", slot=slot_idx,
+                 tenants=[l.tenant.tid for l in lanes if l.tenant],
+                 bucket={"size": list(size), "dtype": dtype},
+                 devices=len(devs))
+
+        def backfill(lane: Lane, slot_step: int, state_arr):
+            """Replace a retired/evicted lane from the queue (same bucket
+            only) or mark it dead (zeros)."""
+            job = None
+            for cand in list(queue):
+                if cand.bucket() == bucket:
+                    job = cand
+                    queue.remove(cand)
+                    break
+            if job is None:
+                lane.tenant = None
+                return state_arr.at[lane.idx].set(
+                    jnp.zeros((p.z, p.y, p.x), dtype))
+            t0_step, padded = lane_init(job)
+            if t0_step >= job.steps:
+                g = padded[off.z:off.z + z, off.y:off.y + y,
+                           off.x:off.x + x]
+                results[job.tid] = TenantResult(
+                    job.tid, "done", job.steps, self.tenant_dir(job.tid),
+                    final=np.ascontiguousarray(g))
+                return backfill(lane, slot_step, state_arr)
+            lane.tenant = job
+            lane.start_slot_step = slot_step
+            lane.start_tenant_step = t0_step
+            rec.meta("campaign.backfill", tenant=job.tid, lane=lane.idx,
+                     slot=slot_idx, slot_step=int(slot_step))
+            return state_arr.at[lane.idx].set(jnp.asarray(padded))
+
+        # -- the guarded slot loop -------------------------------------------
+        slot_step = 0
+        stash: Tuple[int, dict] = (0, {QUANTITY: curr})
+        lat: List[float] = []
+        cell_steps = 0
+        wall = 0.0
+
+        def step_fn(st, k):
+            loop = self._loop(spec, bucket, k, sh, sel_sh, devs)
+            c, _scratch = loop(st[QUANTITY], nxt0, sel)
+            hard_sync(c)
+            return {QUANTITY: c}
+
+        def on_chunk(st, k, per, done_now):
+            nonlocal cell_steps, wall
+            n_active = sum(1 for l in lanes if l.tenant is not None)
+            lat.append(per)
+            cell_steps += k * n_active * cells
+            wall += per * k
+            rec.gauge("campaign.step_latency_s", per, phase="step",
+                      unit="s", mode="batched", slot=slot_idx, iters=k)
+
+        def save_fn(s, st):
+            nonlocal stash
+            stash = (s, dict(st))
+            host = np.asarray(jax.device_get(st[QUANTITY]))
+            for l in lanes:
+                if l.tenant is None:
+                    continue
+                self._write_tenant_snapshot(l.tenant, spec, host[l.idx],
+                                            l.tenant_step(s))
+
+        def restore_fn():
+            s, st = stash
+            return s, dict(st)
+
+        while any(l.tenant is not None for l in lanes):
+            end = min(l.end_slot_step() for l in lanes
+                      if l.tenant is not None)
+            state = {QUANTITY: curr}
+            stash = (slot_step, dict(state))
+
+            def plan_fn(s):
+                return chunk_plan(
+                    s, end, self.chunk,
+                    every=(self.ckpt_every, guard.every),
+                    at=injector.steps() if injector is not None else (),
+                )
+
+            try:
+                state, done = run_guarded(
+                    state, start=slot_step, iters=end, plan_fn=plan_fn,
+                    step_fn=step_fn, guard=guard, injector=injector,
+                    policy=self.policy,
+                    save_fn=save_fn if self.ckpt_every > 0 else None,
+                    ckpt_every=self.ckpt_every, restore_fn=restore_fn,
+                    on_chunk=on_chunk, spec=None,
+                    ckpt_dir=self.campaign_dir,
+                    evidence_dir=self.campaign_dir, app="campaign",
+                )
+            except RecoveryExhausted as e:
+                curr = self._evict(e, spec, lanes, stash, backfill,
+                                   results, slot_idx)
+                slot_step = stash[0]
+                continue
+            slot_step = done
+            curr = state[QUANTITY]
+            # segment end passed a health check (run_guarded checks at
+            # done >= iters): retire every lane whose tenant is complete
+            host = np.asarray(jax.device_get(curr))
+            for l in lanes:
+                if l.tenant is None:
+                    continue
+                if l.tenant_step(slot_step) < l.tenant.steps:
+                    continue
+                job = l.tenant
+                g = host[l.idx, off.z:off.z + z, off.y:off.y + y,
+                         off.x:off.x + x]
+                self._write_tenant_snapshot(job, spec, host[l.idx],
+                                            job.steps)
+                results[job.tid] = TenantResult(
+                    job.tid, "done", job.steps, self.tenant_dir(job.tid),
+                    final=np.ascontiguousarray(g))
+                rec.meta("campaign.retire", tenant=job.tid,
+                         step=int(job.steps), lane=l.idx, slot=slot_idx)
+                curr = backfill(l, slot_step, curr)
+
+        return {"latency_samples": lat, "cell_steps": cell_steps,
+                "wall_s": wall}
+
+    def _evict(self, e: RecoveryExhausted, spec: GridSpec,
+               lanes: List[Lane], stash, backfill, results,
+               slot_idx: int):
+        """The rc-43 eviction path: evidence moves to the tenant dir, the
+        tenant's last healthy state becomes a revivable snapshot, the
+        lane is backfilled, and the slot resumes from the stash."""
+        rec = telemetry.get()
+        f = e.fault
+        if not isinstance(f, TenantFault):
+            raise e  # unattributable: nothing sane to evict
+        lane = lanes[f.lane]
+        if lane.tenant is None or lane.tenant.tid != f.tenant:
+            raise e  # the lane moved under us: refuse to evict blindly
+        job = lane.tenant
+        tdir = self.tenant_dir(job.tid)
+        os.makedirs(tdir, exist_ok=True)
+        evidence = None
+        if e.evidence_path and os.path.isfile(e.evidence_path):
+            evidence = os.path.join(tdir, "fault-evidence.json")
+            shutil.move(e.evidence_path, evidence)
+        sstep, sstate = stash
+        host = np.asarray(jax.device_get(sstate[QUANTITY]))
+        healthy_tstep = lane.tenant_step(sstep)
+        # revivable: persist the last health-checked state BEFORE the
+        # lane is overwritten by the backfill
+        self._write_tenant_snapshot(job, spec, host[lane.idx],
+                                    healthy_tstep)
+        results[job.tid] = TenantResult(
+            job.tid, "fault", healthy_tstep, tdir, evidence=evidence)
+        rec.meta("campaign.evict", tenant=job.tid,
+                 step=int(f.tenant_step), lane=lane.idx, slot=slot_idx,
+                 rc=FAULT_RC, healthy_step=int(healthy_tstep),
+                 evidence=evidence)
+        log.warn(f"campaign: evicted tenant {job.tid} (lane {lane.idx}) "
+                 f"after {e.rollbacks} rollback(s) at tenant step "
+                 f"{f.tenant_step}; slot resumes from step {sstep}")
+        return backfill(lane, sstep, sstate[QUANTITY])
+
+
+# -- the sequential baseline ---------------------------------------------------
+
+
+def run_sequential(jobs: Sequence[TenantJob], *,
+                   devices: Optional[Sequence] = None, radius: int = 1,
+                   chunk: int = 2,
+                   cache: Optional[CompileCache] = None) -> dict:
+    """Serve the same jobs one tenant at a time through the standard
+    single-domain machinery (``DistributedDomain`` partitioned over ALL
+    the given devices + ``make_jacobi_loop``): the honest baseline of
+    ``campaign_batched_over_sequential``. One domain + compiled loop is
+    reused per shape bucket (sequential serving amortizes compiles too —
+    the ratio measures batching, not compilation); timing covers the
+    stepping loop, and per-chunk per-step latencies feed the same
+    p50/p99 statistics as the batched driver."""
+    from ..api import DistributedDomain
+    from ..ops.jacobi import make_jacobi_loop
+    from ..parallel.exchange import shard_blocks
+    from ..plan.ir import PlanConfig
+
+    devices = list(devices) if devices is not None else jax.devices()
+    cache = cache if cache is not None else CompileCache()
+    rec = telemetry.get()
+    results: Dict[str, TenantResult] = {}
+    lat: List[float] = []
+    cell_steps = 0
+    wall = 0.0
+    t0 = time.perf_counter()
+
+    by_bucket: Dict[Tuple, List[TenantJob]] = {}
+    order: List[Tuple] = []
+    for j in jobs:
+        b = j.bucket()
+        if b not in by_bucket:
+            by_bucket[b] = []
+            order.append(b)
+        by_bucket[b].append(j)
+
+    for bucket in order:
+        (size, dtype) = bucket
+        x, y, z = size
+        cells = x * y * z
+        dd = DistributedDomain(x, y, z)
+        dd.set_radius(radius)
+        dd.set_devices(devices)
+        h = dd.add_data(QUANTITY, dtype)
+        dd.realize()
+        sel = shard_blocks(sphere_sel((x, y, z)), dd.spec, dd.mesh)
+        shape = dd.spec.stacked_shape_zyx()
+        cfg = PlanConfig.make(Dim3(x, y, z), dd.spec.radius, [dtype],
+                              len(devices), devices[0].platform)
+
+        def loop_for(k):
+            key = cache_key(cfg, workload="jacobi-sequential",
+                            iters=int(k),
+                            partition=[dd.spec.dim.x, dd.spec.dim.y,
+                                       dd.spec.dim.z],
+                            devices=[d.id for d in devices])
+            return cache.get(
+                key, lambda: make_jacobi_loop(dd.halo_exchange, k))
+
+        for job in by_bucket[bucket]:
+            dd.set_curr_global(h, tenant_init_field(job))
+            c = dd.get_curr(h)
+            n2 = jax.device_put(jnp.zeros(shape, dtype), dd.sharding())
+            done = 0
+            for k in chunk_plan(0, job.steps, chunk):
+                loop = loop_for(k)
+                t1 = time.perf_counter()
+                c, n2 = loop(c, n2, sel)
+                hard_sync(c)
+                per = (time.perf_counter() - t1) / k
+                done += k
+                lat.append(per)
+                cell_steps += k * cells
+                wall += per * k
+                rec.gauge("campaign.step_latency_s", per, phase="step",
+                          unit="s", mode="sequential", iters=k)
+            dd.set_curr(h, c)
+            results[job.tid] = TenantResult(
+                job.tid, "done", done, "",
+                final=np.ascontiguousarray(dd.get_curr_global(h)))
+
+    agg = cell_steps / wall / 1e6 if wall > 0 else 0.0
+    return {
+        "results": results,
+        "tenants": len(jobs),
+        "slots": 0,
+        "cell_steps": cell_steps,
+        "step_wall_s": wall,
+        "total_wall_s": time.perf_counter() - t0,
+        "aggregate_mcells_per_s": agg,
+        "p50_step_s": percentile(lat, 50) if lat else float("nan"),
+        "p99_step_s": percentile(lat, 99) if lat else float("nan"),
+        "evicted": [],
+        "cache": cache.stats(),
+    }

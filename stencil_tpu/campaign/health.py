@@ -1,0 +1,122 @@
+"""Per-lane numerical health for batched tenant slots.
+
+The single-domain :class:`~stencil_tpu.fault.health.HealthGuard` reduces
+every quantity to ONE (all-finite, max|u|) pair — right for one domain,
+wrong for a batch slot, where one tenant's NaN must never condemn its B-1
+siblings. :class:`SlotHealthGuard` keeps the guard's contract (one fused
+jitted reduction, one host round-trip per check, a ``health.check`` span,
+zero step-loop HLO change) but reduces per LANE: each quantity yields
+``(B,)`` finite flags and ``(B,)`` max magnitudes, and a failed check
+raises :class:`TenantFault` naming the tenant, its lane, and its
+tenant-relative step — what the campaign driver's eviction policy
+dispatches on.
+
+Dead lanes (padding when the queue drained, or a just-evicted slot
+position) are excluded: their zeros are trivially healthy, and nothing
+should ever be attributed to them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fault.health import DIVERGENCE, NONFINITE, HealthGuard, NumericalFault
+from ..obs import telemetry
+
+
+class TenantFault(NumericalFault):
+    """A :class:`NumericalFault` attributed to one tenant lane.
+
+    ``step`` (the base class field) is the SLOT step the failed check
+    observed — what ``fault/recover.run_guarded`` keys its rollback
+    budget on; ``tenant_step`` is the tenant-relative step (lanes
+    backfilled mid-slot run offset from the slot clock)."""
+
+    def __init__(self, kind: str, quantity: str, step: int, *, lane: int,
+                 tenant: str, tenant_step: int,
+                 value: Optional[float] = None):
+        super().__init__(kind, quantity, step, value=value)
+        self.lane = int(lane)
+        self.tenant = str(tenant)
+        self.tenant_step = int(tenant_step)
+
+
+class SlotHealthGuard(HealthGuard):
+    """Per-lane fused health check over ``{name: (B, ...)}`` slot state.
+
+    ``bind(active_fn, tenant_step_fn)`` installs the driver's live lane
+    view: ``active_fn(lane) -> tenant id | None`` and
+    ``tenant_step_fn(lane, slot_step) -> tenant step``. The driver
+    re-binds nothing on backfill — the callables read its mutable lane
+    table."""
+
+    def __init__(self, every: int = 1, max_abs: Optional[float] = None):
+        super().__init__(every=every, max_abs=max_abs)
+        self._active_fn: Callable[[int], Optional[str]] = lambda lane: None
+        self._tstep_fn: Callable[[int, int], int] = lambda lane, step: step
+
+    def bind(self, active_fn, tenant_step_fn) -> None:
+        self._active_fn = active_fn
+        self._tstep_fn = tenant_step_fn
+
+    @staticmethod
+    def _build(state):
+        names = sorted(state)
+        finite, amax = [], []
+        for n in names:
+            x = state[n]
+            axes = tuple(range(1, x.ndim))
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                finite.append(jnp.isfinite(x).all(axis=axes))
+                # f32 is enough for the ceiling verdict (HealthGuard._build)
+                amax.append(
+                    jnp.max(jnp.abs(x), axis=axes).astype(jnp.float32))
+            else:  # integer quantities are trivially healthy
+                finite.append(jnp.ones((x.shape[0],), bool))
+                amax.append(jnp.zeros((x.shape[0],), jnp.float32))
+        return jnp.stack(finite), jnp.stack(amax)
+
+    def check(self, state, step: int) -> None:
+        """Run the fused per-lane reduction; raise :class:`TenantFault`
+        for the first unhealthy ACTIVE lane (lowest lane index — the
+        deterministic order eviction evidence relies on)."""
+        if not state:
+            return
+        rec = telemetry.get()
+        self.checks += 1
+        with rec.span("health.check", phase="health", step=int(step),
+                      quantities=len(state)):
+            finite, amax = self._reduce(dict(state))
+            finite = np.asarray(jax.device_get(finite))
+            amax = np.asarray(jax.device_get(amax))
+        names = sorted(state)
+        nlanes = finite.shape[1] if finite.ndim == 2 else 1
+        for b in range(nlanes):
+            tid = self._active_fn(b)
+            if tid is None:
+                continue  # dead/padding lane: nothing to attribute
+            for i, name in enumerate(names):
+                kind = None
+                if not bool(finite[i, b]):
+                    kind = NONFINITE
+                elif (self.max_abs is not None
+                      and float(amax[i, b]) > self.max_abs):
+                    kind = DIVERGENCE
+                if kind is None:
+                    continue
+                value = float(amax[i, b])
+                tstep = int(self._tstep_fn(b, int(step)))
+                rec.meta("health.fault", fault_kind=kind, quantity=name,
+                         step=int(step),
+                         value=value if math.isfinite(value) else None,
+                         ceiling=self.max_abs, tenant=tid, lane=b,
+                         tenant_step=tstep)
+                raise TenantFault(
+                    kind, name, int(step), lane=b, tenant=tid,
+                    tenant_step=tstep,
+                    value=value if math.isfinite(value) else None)

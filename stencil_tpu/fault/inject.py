@@ -32,6 +32,10 @@ Spec grammar — comma/semicolon-separated items of ``kind@step[:k=v...]``:
 run crosses its step again — e.g. after a rollback — which is how the
 max-rollbacks abort path is driven; the default is fire-once, so a
 rolled-back run recomputes clean, bit-identical state.
+
+``tenant=ID`` pins an injection to one tenant's lane in a multi-tenant
+campaign (steps become tenant-relative there; see
+stencil_tpu/campaign/inject.py). The single-domain plan ignores it.
 """
 
 from __future__ import annotations
@@ -66,6 +70,7 @@ class Injection:
     rc: int = 7           # crash exit code
     seconds: float = 1.0  # slow-phase sleep
     repeat: int = 1       # firings allowed; -1 = every crossing
+    tenant: Optional[str] = None  # campaign lane targeting (campaign/inject)
     fired: int = 0
 
     def due(self, prev_step: int, step: int) -> bool:
@@ -79,6 +84,8 @@ class Injection:
             d["quantity"] = self.quantity
         if self.repeat != 1:
             d["repeat"] = self.repeat
+        if self.tenant:
+            d["tenant"] = self.tenant
         return d
 
 
@@ -118,6 +125,11 @@ def parse_spec(spec: str) -> List[Injection]:
                 inj.seconds = float(v)
             elif k == "repeat":
                 inj.repeat = -1 if v in ("always", "-1") else int(v)
+            elif k == "tenant":
+                # campaign lane targeting (stencil_tpu/campaign/inject.py):
+                # pins the injection to one tenant's lane; the single-domain
+                # FaultPlan ignores it (one domain IS the only tenant)
+                inj.tenant = v
             else:
                 raise ValueError(f"unknown fault option {k!r} in {item!r}")
         out.append(inj)

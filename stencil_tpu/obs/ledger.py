@@ -117,8 +117,8 @@ def mad(values: Iterable[float]) -> float:
 # own config and no history ever accumulates under a key.
 VOLATILE_CONFIG_KEYS = frozenset({
     "metrics_out", "metrics_dma", "run_id", "out", "prefix", "ckpt_dir",
-    "plan_db", "inject", "resume", "paraview", "paraview_every",
-    "checkpoint_period",
+    "campaign_dir", "plan_db", "inject", "resume", "paraview",
+    "paraview_every", "checkpoint_period",
 })
 
 

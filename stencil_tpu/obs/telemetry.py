@@ -59,6 +59,9 @@ REQUIRED_KEYS = ("v", "run", "proc", "kind", "name", "t")
 # records the self-healing layer (stencil_tpu/fault/) emits carry typed
 # payload fields the CI fault gate greps for — validate them here so a
 # renamed or untyped field fails the schema gate, not a post-mortem.
+# The campaign.* and compile.* names are the multi-tenant layer's
+# vocabulary (stencil_tpu/campaign/): eviction/backfill provenance and
+# the compile-cache economics the campaign CI gate pins.
 NAME_FIELDS = {
     "fault.injected": (("fault_kind", str), ("step", int)),
     "health.fault": (("fault_kind", str), ("quantity", str), ("step", int)),
@@ -68,6 +71,15 @@ NAME_FIELDS = {
                          ("fault_step", int)),
     "recover.aborted": (("reason", str), ("step", int)),
     "ckpt.save_skipped": (("reason", str),),
+    "campaign.slot": (("slot", int),),
+    "campaign.retire": (("tenant", str), ("step", int), ("lane", int)),
+    "campaign.backfill": (("tenant", str), ("lane", int)),
+    "campaign.evict": (("tenant", str), ("step", int), ("rc", int)),
+    "campaign.step_latency_s": (("mode", str),),
+    "campaign.summary": (("slots", int), ("tenants", int)),
+    "compile.cache_hit": (("key", str),),
+    "compile.build": (("key", str),),
+    "compile.build_s": (("key", str),),
 }
 
 

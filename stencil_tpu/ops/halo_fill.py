@@ -78,6 +78,43 @@ def unpack_slabs(carrier, nq: int):
     return [carrier] if nq == 1 else [carrier[q] for q in range(nq)]
 
 
+def wrap_fill_batched(spec: GridSpec, a):
+    """Periodic self-wrap halo fill of every *leading-dim* block: ``a`` is
+    ``(..., pz, py, px)`` — e.g. the multi-tenant campaign's stacked
+    ``(B, pz, py, px)`` tenant states — and every trailing (pz, py, px)
+    block is an INDEPENDENT single-block periodic domain whose halos wrap
+    onto itself. Nothing ever crosses the leading axes: the slice
+    assignments below touch only the trailing three dims.
+
+    Fill order is the composed x -> y -> z phase order of
+    ``parallel/exchange.py`` (AXIS_ORDER), each later axis copying the
+    full extent of the earlier axes including their just-filled halos, so
+    edges and corners come out identical to a single-block
+    ``HaloExchange`` self-wrap — the bit-parity anchor of the batched
+    campaign step programs (tests/test_campaign.py)."""
+    off = spec.compute_offset()
+    b = spec.base
+    r = spec.radius
+    xo, yo, zo = off.x, off.y, off.z
+    nx, ny, nz = b.x, b.y, b.z
+    rxm, rxp = r.x(-1), r.x(1)
+    rym, ryp = r.y(-1), r.y(1)
+    rzm, rzp = r.z(-1), r.z(1)
+    if rxm:
+        a = a.at[..., :, :, xo - rxm:xo].set(a[..., :, :, xo + nx - rxm:xo + nx])
+    if rxp:
+        a = a.at[..., :, :, xo + nx:xo + nx + rxp].set(a[..., :, :, xo:xo + rxp])
+    if rym:
+        a = a.at[..., :, yo - rym:yo, :].set(a[..., :, yo + ny - rym:yo + ny, :])
+    if ryp:
+        a = a.at[..., :, yo + ny:yo + ny + ryp, :].set(a[..., :, yo:yo + ryp, :])
+    if rzm:
+        a = a.at[..., zo - rzm:zo, :, :].set(a[..., zo + nz - rzm:zo + nz, :, :])
+    if rzp:
+        a = a.at[..., zo + nz:zo + nz + rzp, :, :].set(a[..., zo:zo + rzp, :, :])
+    return a
+
+
 def _axis_geom(spec: GridSpec, axis: str) -> Tuple[int, int, int]:
     """(offset, size, (rm, rp)) along one axis."""
     off = spec.compute_offset()

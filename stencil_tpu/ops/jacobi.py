@@ -689,6 +689,97 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     return jax.jit(fn, donate_argnums=(0, 1))
 
 
+def make_batched_jacobi_loop(spec, iters: int, *, sharding=None,
+                             sel_sharding=None, use_pallas: bool = False,
+                             batch: Optional[int] = None,
+                             interpret: bool = False):
+    """The multi-tenant batched iteration: ``loop(curr, nxt, sel) ->
+    (new_curr, new_next)`` over ``(B, pz, py, px)`` stacked tenant states,
+    advancing every tenant ``iters`` steps inside ONE compiled program.
+
+    ``spec`` describes ONE tenant as a single-block domain
+    (``GridSpec(size, Dim3(1, 1, 1), radius)``); the leading batch axis
+    stacks B independent tenants. Each tenant is its own periodic box:
+    halos self-wrap per tenant (ops/halo_fill.wrap_fill_batched — the
+    composed x->y->z fill order of a single-block HaloExchange), NEVER
+    across the batch axis, and the sweep is the same
+    :func:`jacobi_sweep` (leading dims ride the ``...`` slices), so each
+    lane is bit-identical to running that tenant through the standard
+    single-domain machinery (pinned by tests/test_campaign.py).
+
+    The program is embarrassingly batch-parallel — zero collectives —
+    so ``sharding`` (a ``NamedSharding`` splitting axis 0 over a 1-D
+    device mesh) serves B tenants across the whole mesh under one jit:
+    the serving program of the campaign driver
+    (stencil_tpu/campaign/driver.py). ``sel_sharding`` covers the sel
+    argument (pass a replicated sharding for a shared ``(pz, py, px)``
+    sel, or reuse ``sharding`` for per-tenant sel).
+
+    ``use_pallas=True`` swaps the XLA shifted-slice sweep for the Pallas
+    kernel with a leading batch grid dimension and all-axes in-kernel
+    wrap (``make_pallas_jacobi_sweep(batch=...)``) — the TPU fast path;
+    it requires ``batch`` (static), an aligned spec, and a per-tenant
+    ``(B, pz, py, px)`` sel. Buffers are NOT donated: the campaign
+    driver keeps live references across rollbacks (fault/recover.py
+    stash semantics), which donation would invalidate.
+    """
+    from ..geometry import Dim3 as _D3
+
+    assert spec.dim == _D3(1, 1, 1), (
+        "batched tenants are single-block domains; got partition "
+        f"{spec.dim} (spatial decomposition and tenant batching do not "
+        "compose yet)"
+    )
+    r = spec.radius
+    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
+        "jacobi needs face radius >= 1 on every side"
+    )
+    off = spec.compute_offset()
+    compute = Rect3(off, off + spec.base)
+
+    pallas_sweep = None
+    if use_pallas:
+        from .pallas_stencil import make_pallas_jacobi_sweep, sel_z_range
+
+        assert batch is not None and batch >= 1, (
+            "use_pallas needs the static batch size"
+        )
+        pallas_sweep = make_pallas_jacobi_sweep(
+            spec, sel_z_range(spec), wrap=(True, True, True),
+            batch=batch, interpret=interpret,
+        )
+
+    from .halo_fill import wrap_fill_batched
+
+    def body(curr, nxt, sel):
+        if pallas_sweep is not None:
+            # all three axes wrap in-kernel (each tenant is periodic onto
+            # itself); jacobi reads only face halos, which the kernel
+            # fills — no separate fill pass exists on this path
+            out = pallas_sweep(curr, nxt, sel)
+            return out, curr
+        cur2 = wrap_fill_batched(spec, curr)
+        masks = (sel == 1, sel == 2)
+        out = jacobi_sweep(cur2, nxt, compute, masks)
+        return out, cur2
+
+    def entry_fn(curr, nxt, sel):
+        if iters == 1:
+            return body(curr, nxt, sel)
+        return jax.lax.fori_loop(
+            0, iters, lambda _, cn: body(cn[0], cn[1], sel), (curr, nxt)
+        )
+
+    with timer.timed("jacobi.build"), timer.trace_range("jacobi.build"):
+        if sharding is None:
+            return jax.jit(entry_fn)
+        return jax.jit(
+            entry_fn,
+            in_shardings=(sharding, sharding, sel_sharding or sharding),
+            out_shardings=(sharding, sharding),
+        )
+
+
 def sphere_masks(global_size) -> Tuple[np.ndarray, np.ndarray]:
     """Hot/cold sphere masks over the global [z,y,x] grid.
 

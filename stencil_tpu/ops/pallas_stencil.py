@@ -122,6 +122,7 @@ def make_pallas_jacobi_sweep(
     interpret: bool = False,
     vma=None,
     wrap: Tuple[bool, bool, bool] = (False, False, False),
+    batch: Optional[int] = None,
 ):
     """Build ``sweep(curr, nxt, sel) -> new_next`` over one padded block
     (pz, py, px) fp32, writing the compute region of ``nxt`` in place.
@@ -134,6 +135,16 @@ def make_pallas_jacobi_sweep(
     itself from the opposite face (valid only when that mesh axis has a
     single block — the self-wrap case). Jacobi reads only face neighbors,
     so filling faces (no edges/corners) suffices.
+
+    ``batch`` stacks B independent tenant blocks on a leading axis: all
+    operands become ``(B, pz, py, px)`` and the grid grows a leading
+    batch dimension — one full tile pass per tenant, each tenant's halos
+    wrapped onto ITSELF (the multi-tenant campaign's fast path,
+    ops/jacobi.make_batched_jacobi_loop). The per-tile pipeline is
+    self-contained per batch step: the t==0 prologue re-primes the
+    double-buffered DMAs and the final tile drains both outstanding
+    stores before the next tenant's pass begins, so no DMA crosses the
+    batch axis.
     """
     assert spec.aligned, "pallas sweep requires GridSpec(aligned=True)"
     p = spec.padded()
@@ -159,9 +170,17 @@ def make_pallas_jacobi_sweep(
     xs = slice(xo_k, xo_k + nx)
 
     def kernel(curr_hbm, nxt_hbm, sel_hbm, out_hbm, in_v, out_v, sel_v, wy_v, s_in, s_out, s_sel, s_wrap):
-        t = pl.program_id(0)
+        if batch is None:
+            t = pl.program_id(0)
+        else:
+            b = pl.program_id(0)
+            t = pl.program_id(1)
         slot = t % 2
         nslot = (t + 1) % 2
+
+        def _ix(*sl):
+            # batched operands carry the tenant index on the leading axis
+            return sl if batch is None else (b, *sl)
 
         def tile_zy(ti):
             zi = ti // n_ty
@@ -174,19 +193,19 @@ def make_pallas_jacobi_sweep(
         def in_dma(s, ti):
             z0, y0 = tile_zy(ti)
             ys = slice(None) if full_rows else pl.ds(y0 - 8, rows_in)
-            src = curr_hbm.at[pl.ds(z0 - 1, tz + 2), ys, _xsl()]
+            src = curr_hbm.at[_ix(pl.ds(z0 - 1, tz + 2), ys, _xsl())]
             return pltpu.make_async_copy(src, in_v.at[s], s_in.at[s])
 
         def sel_dma(s, ti):
             z0, y0 = tile_zy(ti)
             ys = slice(None) if full_rows else pl.ds(y0, ty)
-            src = sel_hbm.at[pl.ds(z0, tz), ys, _xsl()]
+            src = sel_hbm.at[_ix(pl.ds(z0, tz), ys, _xsl())]
             return pltpu.make_async_copy(src, sel_v.at[s], s_sel.at[s])
 
         def out_dma(s, ti):
             z0, y0 = tile_zy(ti)
             ys = slice(None) if full_rows else pl.ds(y0, ty)
-            dst = out_hbm.at[pl.ds(z0, tz), ys, _xsl()]
+            dst = out_hbm.at[_ix(pl.ds(z0, tz), ys, _xsl())]
             return pltpu.make_async_copy(out_v.at[s], dst, s_out.at[s])
 
         def touches_sel(ti):
@@ -222,7 +241,7 @@ def make_pallas_jacobi_sweep(
             @pl.when(zi == 0)
             def _():
                 ys = slice(None) if full_rows else pl.ds(y0 - 8, rows_in)
-                src = curr_hbm.at[pl.ds(zo + nz - 1, 1), ys, _xsl()]
+                src = curr_hbm.at[_ix(pl.ds(zo + nz - 1, 1), ys, _xsl())]
                 cp = pltpu.make_async_copy(src, in_v.at[slot, pl.ds(0, 1)], s_wrap)
                 cp.start()
                 cp.wait()
@@ -230,7 +249,7 @@ def make_pallas_jacobi_sweep(
             @pl.when(zi == n_tz - 1)
             def _():
                 ys = slice(None) if full_rows else pl.ds(y0 - 8, rows_in)
-                src = curr_hbm.at[pl.ds(zo, 1), ys, _xsl()]
+                src = curr_hbm.at[_ix(pl.ds(zo, 1), ys, _xsl())]
                 cp = pltpu.make_async_copy(src, in_v.at[slot, pl.ds(tz + 1, 1)], s_wrap)
                 cp.start()
                 cp.wait()
@@ -246,7 +265,7 @@ def make_pallas_jacobi_sweep(
             @pl.when(yi == 0)
             def _():
                 cp = pltpu.make_async_copy(
-                    curr_hbm.at[pl.ds(z0, tz), pl.ds(yo + ny - 8, 8), _xsl()],
+                    curr_hbm.at[_ix(pl.ds(z0, tz), pl.ds(yo + ny - 8, 8), _xsl())],
                     wy_v, s_wrap
                 )
                 cp.start()
@@ -256,7 +275,7 @@ def make_pallas_jacobi_sweep(
             @pl.when(yi == n_ty - 1)
             def _():
                 cp = pltpu.make_async_copy(
-                    curr_hbm.at[pl.ds(z0, tz), pl.ds(yo, 8), _xsl()],
+                    curr_hbm.at[_ix(pl.ds(z0, tz), pl.ds(yo, 8), _xsl())],
                     wy_v, s_wrap
                 )
                 cp.start()
@@ -321,14 +340,15 @@ def make_pallas_jacobi_sweep(
                 out_dma(nslot, t - 1).wait()
             out_dma(slot, t).wait()
 
+    shape = (pz, py, px) if batch is None else (batch, pz, py, px)
     if vma is None:
-        out_shape = jax.ShapeDtypeStruct((pz, py, px), jnp.float32)
+        out_shape = jax.ShapeDtypeStruct(shape, jnp.float32)
     else:
         # inside shard_map, declare the output varying over the mesh axes
-        out_shape = jax.ShapeDtypeStruct((pz, py, px), jnp.float32, vma=frozenset(vma))
+        out_shape = jax.ShapeDtypeStruct(shape, jnp.float32, vma=frozenset(vma))
     fn = pl.pallas_call(
         kernel,
-        grid=(n_tiles,),
+        grid=(n_tiles,) if batch is None else (batch, n_tiles),
         out_shape=out_shape,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -348,7 +368,10 @@ def make_pallas_jacobi_sweep(
         ],
         input_output_aliases={1: 0},  # nxt buffer is updated in place
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=(
+                ("arbitrary",) if batch is None
+                else ("arbitrary", "arbitrary")
+            ),
             has_side_effects=True,
             vmem_limit_bytes=100 * 1024 * 1024,
         ),

@@ -59,3 +59,21 @@ class Statistics:
         """Tukey's trimean — the reference's headline statistic
         (reference: bin/statistics.hpp:17)."""
         return (self._quantile(0.25) + 2 * self._quantile(0.5) + self._quantile(0.75)) / 4
+
+    def percentile(self, q: float) -> float:
+        """The q-th percentile (0 <= q <= 100), linear-interpolated over
+        the sorted samples — p50/p99 for tail-latency reporting (the
+        multi-tenant campaign's step-latency legs)."""
+        if not self._v:
+            raise ValueError("percentile of an empty sample set")
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile q must be in [0, 100], got {q}")
+        return self._quantile(q / 100.0)
+
+
+def percentile(values: Iterable[float], q: float) -> float:
+    """Module-level convenience: ``Statistics(values).percentile(q)`` —
+    the p50/p99 authority the campaign driver, apps/report.py's optional
+    p99 span column, and bench.py's latency legs share (same linear
+    interpolation as the trimean's quartiles)."""
+    return Statistics(values).percentile(q)

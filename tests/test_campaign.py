@@ -1,0 +1,376 @@
+"""Multi-tenant batched campaigns (stencil_tpu/campaign/).
+
+The ISSUE-9 acceptance pins:
+
+- batched-vs-sequential BIT-parity at B in {1, 4}, fp32 and fp64 — every
+  tenant served by the batched (B, pz, py, px) program finishes
+  bit-identical to the same tenant run through the standard
+  single-domain machinery;
+- deterministic slot packing / backfill order;
+- an injected ``nan@K:tenant=...:repeat=always`` tenant is EVICTED with
+  rc-43 evidence while its siblings finish bit-identical to a clean
+  campaign (and the evicted tenant is revivable from its snapshot);
+- the second same-shape slot is a pure compile-cache hit
+  (``compile.cache_hit`` == 1, zero new ``compile.build`` spans);
+- the campaign/compile telemetry vocabulary is schema-gated;
+- report span tables grow the optional p99 column and split the
+  campaign A/B's ``mode`` tag.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+
+from stencil_tpu.campaign import (
+    CampaignDriver,
+    CompileCache,
+    TenantJob,
+    plan_slots,
+    run_sequential,
+    tenant_init_field,
+)
+from stencil_tpu.obs import telemetry
+from stencil_tpu.obs.telemetry import validate_record
+from stencil_tpu.obs.watchdog import FAULT_RC
+
+N = 12
+STEPS = 4
+
+
+def jobs_for(n_jobs, dtype="float32", size=N, steps=STEPS, seed0=10):
+    return [TenantJob(f"t{i}", (size, size, size), steps, dtype,
+                      seed=seed0 + i) for i in range(n_jobs)]
+
+
+def finals(summary):
+    return {t: r.final for t, r in summary["results"].items()
+            if r.outcome == "done"}
+
+
+# -- batched vs sequential bit-parity -----------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+@pytest.mark.parametrize("slot", [1, 4])
+def test_batched_matches_sequential_bitwise(tmp_path, dtype, slot):
+    jobs = jobs_for(3, dtype)  # 3 jobs: B=4 exercises a dead padding lane
+    seq = run_sequential(jobs, devices=jax.devices()[:8], chunk=2)
+    bat = CampaignDriver(jobs, slot, str(tmp_path / "c"), chunk=2,
+                         devices=jax.devices()[:8]).run()
+    assert bat["evicted"] == []
+    sf, bf = finals(seq), finals(bat)
+    assert set(sf) == set(bf) == {j.tid for j in jobs}
+    for tid in sf:
+        assert bf[tid].dtype == np.dtype(dtype)
+        assert bf[tid].tobytes() == sf[tid].tobytes(), (
+            f"tenant {tid} diverged between batched (B={slot}) and "
+            "sequential")
+    # throughput accounting covers every tenant step
+    cells = N ** 3
+    assert bat["cell_steps"] == len(jobs) * STEPS * cells
+    assert np.isfinite(bat["p50_step_s"]) and np.isfinite(bat["p99_step_s"])
+    assert bat["p99_step_s"] >= bat["p50_step_s"]
+
+
+# -- slot packing / backfill determinism --------------------------------------
+
+
+def test_plan_slots_fifo_bucketed():
+    jobs = [
+        TenantJob("a0", (12, 12, 12), 4),
+        TenantJob("b0", (10, 10, 10), 4),
+        TenantJob("a1", (12, 12, 12), 4),
+        TenantJob("a2", (12, 12, 12), 4),
+        TenantJob("b1", (10, 10, 10), 4),
+        TenantJob("a3", (12, 12, 12), 4),
+    ]
+    got = plan_slots(jobs, 3)
+    # bucket of the queue head first; same-bucket jobs pulled forward in
+    # FIFO order; the fourth 12^3 job overflows into a later slot
+    assert got == [
+        (((12, 12, 12), "float32"), ["a0", "a1", "a2"]),
+        (((10, 10, 10), "float32"), ["b0", "b1"]),
+        (((12, 12, 12), "float32"), ["a3"]),
+    ]
+    # pure + deterministic
+    assert got == plan_slots(jobs, 3)
+
+
+def test_backfill_order_is_deterministic(tmp_path):
+    """6 jobs through B=2 slots: retirement backfills FIFO from the
+    queue, so two identical campaigns record identical slot/backfill
+    sequences."""
+    orders = []
+    for run_i in range(2):
+        m = tmp_path / f"m{run_i}.jsonl"
+        telemetry.configure(metrics_out=str(m), app="t")
+        try:
+            CampaignDriver(jobs_for(6), 2, str(tmp_path / f"c{run_i}"),
+                           chunk=2, devices=jax.devices()[:8]).run()
+        finally:
+            telemetry.get().close()
+        recs = [json.loads(l) for l in open(m) if l.strip()]
+        orders.append([
+            (r["name"], r.get("tenant") or ",".join(r.get("tenants", [])))
+            for r in recs
+            if r["name"] in ("campaign.slot", "campaign.backfill",
+                             "campaign.retire")
+        ])
+    assert orders[0] == orders[1]
+    # the first slot is t0/t1; backfills arrive in queue order
+    backfills = [t for (n, t) in orders[0] if n == "campaign.backfill"]
+    assert backfills == ["t2", "t3", "t4", "t5"]
+
+
+# -- eviction: rc-43 evidence, surviving lanes bit-identical ------------------
+
+
+def test_injected_tenant_evicted_survivors_bit_identical(tmp_path):
+    jobs = jobs_for(5, steps=6)
+    clean = CampaignDriver(jobs, 4, str(tmp_path / "clean"), chunk=2,
+                           ckpt_every=2, max_rollbacks=1,
+                           devices=jax.devices()[:8]).run()
+    assert clean["evicted"] == []
+
+    telemetry.configure(metrics_out=str(tmp_path / "m.jsonl"), app="t")
+    try:
+        inj = CampaignDriver(
+            jobs, 4, str(tmp_path / "inj"), chunk=2, ckpt_every=2,
+            max_rollbacks=1, rollback_backoff=0.01,
+            inject="nan@3:tenant=t1:repeat=always",
+            devices=jax.devices()[:8]).run()
+    finally:
+        telemetry.get().close()
+
+    # the injected tenant is evicted with the rc-43 evidence bundle...
+    assert inj["evicted"] == ["t1"]
+    r1 = inj["results"]["t1"]
+    assert r1.outcome == "fault"
+    assert r1.evidence and os.path.isfile(r1.evidence)
+    ev = json.load(open(r1.evidence))
+    assert ev["rc"] == FAULT_RC
+    assert "max rollbacks" in ev["reason"]
+    # ...its lane was backfilled and every other tenant completed,
+    # bit-identical to the uninjected campaign
+    cf, inf_ = finals(clean), finals(inj)
+    assert set(inf_) == {j.tid for j in jobs} - {"t1"}
+    for tid in inf_:
+        assert inf_[tid].tobytes() == cf[tid].tobytes(), tid
+    # metrics: injection, per-lane fault, rollback, eviction all recorded
+    recs = [json.loads(l) for l in open(tmp_path / "m.jsonl") if l.strip()]
+    assert all(not validate_record(r) for r in recs)
+    names = {r["name"] for r in recs}
+    assert {"fault.injected", "health.fault", "recover.rollback",
+            "campaign.evict", "campaign.backfill"} <= names
+    evict = [r for r in recs if r["name"] == "campaign.evict"]
+    assert evict[0]["tenant"] == "t1" and evict[0]["rc"] == FAULT_RC
+
+    # revivable: the evicted tenant's last healthy state is a snapshot;
+    # a resumed single-tenant campaign finishes it bit-identical to clean
+    rev = CampaignDriver([jobs[1]], 2, str(tmp_path / "inj"), chunk=2,
+                         resume=True, devices=jax.devices()[:8]).run()
+    rr = rev["results"]["t1"]
+    assert rr.outcome == "done" and rr.steps == jobs[1].steps
+    assert rr.final.tobytes() == cf["t1"].tobytes()
+
+
+# -- compile cache: the second same-shape slot is a pure hit ------------------
+
+
+def test_second_same_shape_slot_hits_compile_cache(tmp_path):
+    cache = CompileCache()
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        CampaignDriver(jobs_for(2, seed0=0), 2, str(tmp_path / "c1"),
+                       chunk=2, cache=cache,
+                       devices=jax.devices()[:8]).run()
+        misses_after_first = cache.misses
+        first_lines = [json.loads(l) for l in open(m) if l.strip()]
+        builds_after_first = sum(
+            1 for r in first_lines if r["name"] == "compile.build")
+        lookups_after_first = sum(
+            1 for r in first_lines if r["name"] == "compile.cache_hit")
+        CampaignDriver(jobs_for(2, seed0=9), 2, str(tmp_path / "c2"),
+                       chunk=2, cache=cache,
+                       devices=jax.devices()[:8]).run()
+    finally:
+        telemetry.get().close()
+    # zero rebuilds: no new compile.build spans, no new misses
+    assert cache.misses == misses_after_first
+    assert cache.hits >= 1
+    recs = [json.loads(l) for l in open(m) if l.strip()]
+    builds = [r for r in recs if r["name"] == "compile.build"]
+    assert len(builds) == builds_after_first == misses_after_first
+    hits = [r for r in recs if r["name"] == "compile.cache_hit"]
+    second = [r["value"] for r in hits[lookups_after_first:]]
+    # the second campaign's lookups are all hits (gauge pinned at 1)
+    assert second and all(v == 1 for v in second)
+    for r in builds + hits:
+        assert isinstance(r["key"], str) and '"grid"' in r["key"]
+
+
+# -- the batched Pallas fast path (interpret mode) ----------------------------
+
+
+@pytest.mark.slow
+def test_batched_pallas_sweep_matches_xla(tmp_path):
+    """The leading-batch-grid Pallas kernel (all-axes in-kernel wrap, one
+    tile pass per tenant) is bit-identical to the XLA batched path —
+    interpret mode, the CI stand-in for TPU hardware."""
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.ops.jacobi import make_batched_jacobi_loop, sphere_sel
+
+    B, nx, ny, nz = 2, 128, 8, 8
+    spec = GridSpec(Dim3(nx, ny, nz), Dim3(1, 1, 1), Radius.constant(1))
+    p, off = spec.padded(), spec.compute_offset()
+    rng = np.random.RandomState(5)
+    curr = np.zeros((B, p.z, p.y, p.x), np.float32)
+    sel = np.zeros((B, p.z, p.y, p.x), np.int32)
+    sel_g = sphere_sel((nx, ny, nz))
+    for b in range(B):
+        curr[b, off.z:off.z + nz, off.y:off.y + ny, off.x:off.x + nx] = (
+            rng.standard_normal((nz, ny, nx)).astype(np.float32))
+        sel[b, off.z:off.z + nz, off.y:off.y + ny, off.x:off.x + nx] = sel_g
+    nxt = np.zeros_like(curr)
+
+    import jax.numpy as jnp
+
+    xla = make_batched_jacobi_loop(spec, 1)
+    pal = make_batched_jacobi_loop(spec, 1, use_pallas=True, batch=B,
+                                   interpret=True)
+    cx, _ = xla(jnp.asarray(curr), jnp.asarray(nxt), jnp.asarray(sel))
+    cp, _ = pal(jnp.asarray(curr), jnp.asarray(nxt), jnp.asarray(sel))
+    ix = np.asarray(cx)[:, off.z:off.z + nz, off.y:off.y + ny,
+                        off.x:off.x + nx]
+    ip = np.asarray(cp)[:, off.z:off.z + nz, off.y:off.y + ny,
+                        off.x:off.x + nx]
+    assert ix.tobytes() == ip.tobytes()
+
+
+# -- the batched astaroth XLA path --------------------------------------------
+
+
+@pytest.mark.slow
+def test_batched_astaroth_matches_single_domain():
+    """Each lane of make_batched_astaroth_step equals the single-domain
+    make_astaroth_step hoisted-overlap iteration — same tolerance
+    discipline as the astaroth suite (test_astaroth.py)."""
+    import jax.numpy as jnp
+
+    from stencil_tpu.astaroth import config as ac_config
+    from stencil_tpu.astaroth.integrate import (
+        FIELDS, make_astaroth_step, make_batched_astaroth_step)
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.parallel import HaloExchange, grid_mesh
+    from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
+
+    n, B, dt, iters = 16, 2, 1e-3, 2
+    info = ac_config.AcMeshInfo()
+    conf = os.path.join(os.path.dirname(__file__), "..", "stencil_tpu",
+                        "astaroth", "astaroth.conf")
+    with open(conf) as f:
+        ac_config.parse_config(f.read(), info)
+    info.int_params["AC_nx"] = info.int_params["AC_ny"] = n
+    info.int_params["AC_nz"] = n
+    info.update_builtin_params()
+    rng = np.random.RandomState(11)
+    tenants = []
+    for _ in range(B):
+        f = {k: rng.randn(n, n, n) * 0.05 for k in FIELDS}
+        f["lnrho"] = f["lnrho"] + 0.5
+        tenants.append(f)
+
+    spec1 = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3))
+    mesh1 = grid_mesh(spec1.dim, jax.devices()[:1])
+    step = make_astaroth_step(HaloExchange(spec1, mesh1), info, dt=dt,
+                              iters=iters)
+    seq = []
+    for b in range(B):
+        curr = {k: shard_blocks(tenants[b][k], spec1, mesh1)
+                for k in FIELDS}
+        nxt = {k: shard_blocks(np.zeros((n, n, n)), spec1, mesh1)
+               for k in FIELDS}
+        curr, nxt = step(curr, nxt)
+        seq.append({k: unshard_blocks(curr[k], spec1) for k in FIELDS})
+
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3),
+                    aligned=False)
+    p, off = spec.padded(), spec.compute_offset()
+
+    def pack(key):
+        a = np.zeros((B, p.z, p.y, p.x))
+        for b in range(B):
+            a[b, off.z:off.z + n, off.y:off.y + n, off.x:off.x + n] = (
+                tenants[b][key])
+        return jnp.asarray(a)
+
+    curr = {k: pack(k) for k in FIELDS}
+    nxt = {k: jnp.zeros((B, p.z, p.y, p.x)) for k in FIELDS}
+    bstep = make_batched_astaroth_step(spec, info, dt=dt, iters=iters)
+    curr, nxt = bstep(curr, nxt)
+    for b in range(B):
+        for k in FIELDS:
+            got = np.asarray(curr[k])[b, off.z:off.z + n, off.y:off.y + n,
+                                      off.x:off.x + n]
+            np.testing.assert_allclose(got, seq[b][k], rtol=1e-10,
+                                       atol=1e-12, err_msg=f"{b}/{k}")
+
+
+# -- telemetry vocabulary ------------------------------------------------------
+
+
+def test_campaign_vocabulary_schema_gated():
+    base = {"v": 1, "run": "r", "proc": 0, "t": 0.0}
+    ok = dict(base, kind="meta", name="campaign.evict", tenant="t1",
+              step=3, rc=43)
+    assert validate_record(ok) == []
+    for missing in ("tenant", "step", "rc"):
+        bad = dict(ok)
+        del bad[missing]
+        assert any(missing in e for e in validate_record(bad))
+    g = dict(base, kind="gauge", name="compile.cache_hit", value=1)
+    assert any("key" in e for e in validate_record(g))
+    assert validate_record(dict(g, key="k")) == []
+    lat = dict(base, kind="gauge", name="campaign.step_latency_s",
+               value=0.1)
+    assert any("mode" in e for e in validate_record(lat))
+    assert validate_record(dict(lat, mode="batched")) == []
+
+
+# -- report: p99 span column + mode tag split ---------------------------------
+
+
+def test_report_p99_column_and_mode_split():
+    from stencil_tpu.apps.report import aggregate, tables
+
+    def rec(kind, name, **kw):
+        return dict({"v": 1, "run": "r", "proc": 0, "kind": kind,
+                     "name": name, "t": 0.0}, **kw)
+
+    records = [rec("span", "campaign.chunk", seconds=s, phase="step")
+               for s in (0.01,) * 99 + (1.0,)]
+    records += [rec("gauge", "campaign.step_latency_s", value=0.1,
+                    mode="batched"),
+                rec("gauge", "campaign.step_latency_s", value=9.0,
+                    mode="sequential")]
+    agg = aggregate(records)
+    # the A/B modes never fold into one gauge row
+    assert "campaign.step_latency_s[batched]" in agg["gauges"]
+    assert "campaign.step_latency_s[sequential]" in agg["gauges"]
+    out = tables(agg, p99=True)
+    header = [l for l in out.splitlines() if l.startswith("span,")][0]
+    assert header.endswith("p99_s")
+    row = [l for l in out.splitlines() if l.startswith("campaign.chunk")][0]
+    # p99 of 99x0.01 + 1x1.0 sits just above 0.01 — far from max
+    p99 = float(row.split(",")[-1])
+    assert 0.01 < p99 < 0.1
+    # default stays the historical table (no new column)
+    assert "p99_s" not in tables(agg)

@@ -1,4 +1,7 @@
+import pytest
+
 from stencil_tpu.utils import Statistics
+from stencil_tpu.utils.statistics import percentile
 
 
 def test_basic_stats():
@@ -21,3 +24,38 @@ def test_insert_keeps_sorted():
     s.insert(1.0)
     s.insert(2.0)
     assert s.min() == 1.0 and s.max() == 3.0
+
+
+def test_percentile_matches_median_and_extremes():
+    s = Statistics([1, 2, 3, 4, 5])
+    assert s.percentile(50) == s.med() == 3.0
+    assert s.percentile(0) == 1.0
+    assert s.percentile(100) == 5.0
+
+
+def test_percentile_interpolates():
+    # 99th percentile of 0..100 (101 samples) lands exactly on 99; with
+    # 100 samples 0..99 it interpolates: pos = .99*99 = 98.01 -> 98.01
+    assert percentile(range(101), 99) == 99.0
+    assert percentile(range(100), 99) == pytest.approx(98.01)
+    # the tail statistic the campaign legs exist for: one outlier among
+    # uniform samples pulls p99 off the median but not to the max
+    vals = [0.01] * 99 + [1.0]
+    p99 = percentile(vals, 99)
+    assert 0.01 < p99 < 1.0
+    assert percentile(vals, 50) == 0.01
+
+
+def test_percentile_rejects_bad_input():
+    with pytest.raises(ValueError):
+        percentile([], 50)
+    with pytest.raises(ValueError):
+        percentile([1.0], 101)
+    with pytest.raises(ValueError):
+        percentile([1.0], -1)
+
+
+def test_percentile_module_level_equals_method():
+    vals = [5.0, 1.0, 4.0, 2.0, 3.0]
+    for q in (0, 25, 50, 75, 90, 99, 100):
+        assert percentile(vals, q) == Statistics(vals).percentile(q)
