@@ -211,6 +211,24 @@ def _child_main(mode: str, resume: bool = False) -> int:
         except Exception as e:
             errors["exchange_auto"] = f"{type(e).__name__}: {e}"[:400]
 
+    # kernel-initiated remote-DMA exchange (ISSUE 10 / ROADMAP #2): the
+    # fourth transport vs the composed baseline at the same config, on an
+    # 8-device mesh so phases actually cross the wire. On TPU this times
+    # the Pallas carrier kernels (pltpu.make_async_remote_copy — the
+    # tx_colocated analogue, 0 ppermutes); on the CPU child it times the
+    # host-orchestrated emulation, which is a CORRECTNESS vehicle — the
+    # ratio is expected < 1 there and only the TPU number is the claim.
+    ex_rd_gb_s = 0.0
+    ex_rd_base_gb_s = 0.0
+    if leg("halo exchange (remote-dma)"):
+        try:
+            rd = dict(nq=4, ndev=8 if len(jax.devices()) >= 8 else 1,
+                      nb=min(n, 128))
+            ex_rd_gb_s = _exchange_leg(Method.REMOTE_DMA, **rd)
+            ex_rd_base_gb_s = _exchange_leg(Method.AXIS_COMPOSED, **rd)
+        except Exception as e:
+            errors["exchange_remote_dma"] = f"{type(e).__name__}: {e}"[:400]
+
     # quantity-batching A/B at Q=8 (the astaroth field count): one packed
     # ppermute carrier per axis phase vs one collective per quantity. On an
     # 8-device mesh (the CPU child forces 8 virtual devices) the partition
@@ -376,6 +394,16 @@ def _child_main(mode: str, resume: bool = False) -> int:
         "exchange_auto_gb_per_s": round(ex_auto_gb_s, 2),
         "exchange_manual_over_auto": (
             round(ex_gb_s / ex_auto_gb_s, 3) if ex_auto_gb_s else 0.0
+        ),
+        # kernel-initiated remote-DMA transport over the composed ppermute
+        # baseline at the same 8-dev config (> 1 means bypassing the XLA
+        # collective path won; expected < 1 on the CPU emulation — only
+        # the TPU carrier-kernel number carries the §5.8 claim)
+        "exchange_remote_dma_gb_per_s": round(ex_rd_gb_s, 2),
+        "exchange_remote_dma_base_gb_per_s": round(ex_rd_base_gb_s, 2),
+        "exchange_remote_dma_over_composed": (
+            round(ex_rd_gb_s / ex_rd_base_gb_s, 3)
+            if ex_rd_base_gb_s else 0.0
         ),
         # quantity-batching leg (Q=8, the astaroth field count): batched
         # packed-carrier exchange over the per-quantity program

@@ -1,0 +1,122 @@
+"""On-chip probe: Method.REMOTE_DMA carrier kernels vs ppermute methods.
+
+The ISSUE-10 hardware half (ROADMAP #2 -> #1): the kernel-initiated
+exchange (ops/remote_dma.py — per-neighbor ``pltpu.make_async_remote_copy``
+from inside the carrier kernel, 0 collective-permutes in the compiled
+program) exists and is parity-pinned on the CPU emulation, but the claim
+it was built for — per-collective DISPATCH overhead, not bytes, dominates
+this stack (round-7/10 censuses), so bypassing the XLA collective path
+should beat the composed ppermute transport — needs real ICI. This probe
+is the decisive A/B, staged for a multi-chip TPU session:
+
+1. composed / direct26 / auto-spmd / remote-dma back-to-back at the probe
+   config (radius 2, 4 fp32 quantities, one block per chip), trimean
+   ms/exchange + GB/s logical, with the 0-ppermute census verified on the
+   compiled remote program;
+2. the same remote-dma leg with ``--wire-dtype bfloat16``: on TPU the
+   carrier really ships bf16 (no CPU float-normalization widening), so
+   this measures what halving the wire bytes buys on real links;
+3. numbers feed ``plan/cost.py DEFAULT_CALIBRATION["remote_dma"]``
+   (provenance flips modeled -> measured) and the plan DB via
+   ``plan_tool autotune`` (item-1 recalibration session).
+
+Needs >= 2 TPU chips (a single chip self-wraps every phase and issues no
+remote DMA). Exits early with one line when no TPU is present;
+``--cpu-smoke`` runs a tiny emulation pass instead (the CI-covered path).
+
+Usage: python scripts/probe_remote_dma.py [n] [chunk]
+       python scripts/probe_remote_dma.py --cpu-smoke
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+cpu_smoke = "--cpu-smoke" in sys.argv
+args = [a for a in sys.argv[1:] if a != "--cpu-smoke"]
+
+if cpu_smoke:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import stencil_tpu  # noqa: F401  (jax-compat shims first)
+import jax
+
+if cpu_smoke:
+    jax.config.update("jax_platforms", "cpu")
+
+if not cpu_smoke and jax.devices()[0].platform != "tpu":
+    print("probe_remote_dma: no TPU on this host — run on the bench host "
+          "(or --cpu-smoke for the emulation path)")
+    raise SystemExit(0)
+
+import numpy as np
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks
+from stencil_tpu.utils.statistics import Statistics
+from stencil_tpu.utils.sync import hard_sync
+
+n = int(args[0]) if args else (16 if cpu_smoke else 256)
+chunk = int(args[1]) if len(args) > 1 else (2 if cpu_smoke else 60)
+ndev = min(8, len(jax.devices()))
+if ndev < 2:
+    print(f"probe_remote_dma: {ndev} device(s) — remote DMA needs a "
+          "multi-chip ring (single chip self-wraps every phase)")
+    raise SystemExit(0)
+
+# the largest 3-factor split of ndev, z-major (grid_mesh handles ICI layout)
+from stencil_tpu.geometry import NodePartition
+
+part = NodePartition(Dim3(n, n, n), Radius.constant(2), 1, ndev).dim()
+spec = GridSpec(Dim3(n, n, n), part, Radius.constant(2))
+mesh = grid_mesh(part, jax.devices()[:ndev])
+NQ = 4
+
+
+def leg(method, wire_dtype=None):
+    ex = HaloExchange(spec, mesh, method, wire_dtype=wire_dtype)
+    loop = ex.make_loop(chunk)
+    state = {
+        i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
+        for i in range(NQ)
+    }
+    t0 = time.time()
+    state = loop(state)
+    hard_sync(state)
+    build_s = time.time() - t0
+    st = Statistics()
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state = loop(state)
+        hard_sync(state)
+        st.insert((time.perf_counter() - t0) / chunk)
+    census = ex.collective_census(
+        {i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
+         for i in range(NQ)})
+    cp = census.get("collective-permute", (0, 0))
+    gb = ex.bytes_logical([4] * NQ) / st.trimean() / 1e9
+    tag = method.value + (f"+wire={wire_dtype}" if wire_dtype else "")
+    print(f"{tag:34s} {st.trimean()*1e3:9.3f} ms/exchange  {gb:7.2f} GB/s  "
+          f"permutes={cp[0]:3d} cp_bytes={cp[1]}  (compile {build_s:.0f}s)",
+          flush=True)
+    return st.trimean(), cp
+
+
+print(f"remote-dma probe: {n}^3, partition {part}, {ndev} devices, r2, "
+      f"{NQ} fp32 quantities, chunk {chunk}", flush=True)
+t_comp, _ = leg(Method.AXIS_COMPOSED)
+if not cpu_smoke:
+    leg(Method.DIRECT26)
+    leg(Method.AUTO_SPMD)
+t_rd, cp_rd = leg(Method.REMOTE_DMA)
+assert cp_rd[0] == 0, f"REMOTE_DMA census shows {cp_rd[0]} ppermutes"
+leg(Method.REMOTE_DMA, wire_dtype="bfloat16")
+kind = ("TPU carrier kernel" if not cpu_smoke
+        else "CPU emulation — correctness vehicle, ratio not a claim")
+print(f"remote_dma_over_composed: {t_comp / t_rd:.3f}x ({kind})", flush=True)

@@ -66,6 +66,7 @@ class DistributedDomain:
         self._dtypes: List[str] = []
         self._method = Method.AXIS_COMPOSED
         self._batch_quantities = True
+        self._wire_dtype: Optional[str] = None
         self._devices: Optional[Sequence] = None
         self._partition_dim: Optional[Dim3] = None
         self._placement = None
@@ -154,6 +155,18 @@ class DistributedDomain:
         the historical one-collective-per-quantity program — the A/B
         baseline of ``bench_exchange --batched-ab``."""
         self._batch_quantities = bool(enabled)
+
+    def set_wire_dtype(self, dtype) -> None:
+        """bf16-on-the-wire halo compression (``None`` = off): boundary
+        carriers that actually cross the interconnect narrow to this
+        dtype before the send and widen on unpack
+        (``HaloExchange(wire_dtype=...)``; ops/halo_fill.wire_narrow_dtype
+        owns the policy — only floating carriers ever narrow, local
+        copies stay lossless). LOSSY by design: the exchanged halos
+        round to the wire precision, so checkpoints/parity comparisons
+        across the knob differ. ``bench_exchange --wire-ab`` measures
+        the error the bandwidth is bought with."""
+        self._wire_dtype = None if dtype in (None, "") else str(jnp.dtype(dtype))
 
     def set_devices(self, devices: Sequence) -> None:
         """Restrict to specific devices (reference ``set_gpus``,
@@ -259,6 +272,7 @@ class DistributedDomain:
             self._exchange = HaloExchange(
                 self.spec, self.mesh, self._method,
                 batch_quantities=self._batch_quantities,
+                wire_dtype=self._wire_dtype,
             )
             sharding = self._exchange.sharding()
             for idx, dt in enumerate(self._dtypes):
@@ -418,7 +432,8 @@ class DistributedDomain:
             kernel_variant=ch.kernel_variant if ch is not None else None,
         )
         return {"key": cfg.to_json(), "choice": choice.to_json(),
-                "tuned": ch is not None}
+                "tuned": ch is not None,
+                "wire_dtype": self._wire_dtype}
 
     def _warn_plan_mismatch(self, manifest: dict) -> None:
         saved = (manifest.get("meta") or {}).get("plan")
@@ -433,13 +448,31 @@ class DistributedDomain:
             # stay quiet; method/batching deltas still mix programs
             saved_ch.pop("partition", None)
             here_ch.pop("partition", None)
-        if saved_ch != here_ch:
+        # the comparison is data-driven (plain dicts), so a snapshot
+        # written under a method this build does not know — REMOTE_DMA
+        # from a newer build, or any future transport — still WARNS
+        # instead of crashing on an unknown enum name; name the methods
+        # in the message so the operator sees what moved
+        saved_m = saved_ch.get("method")
+        here_m = here_ch.get("method")
+        known = {m.value for m in Method}
+        unknown = (f" (method {saved_m!r} is unknown to this build)"
+                   if saved_m is not None and saved_m not in known else "")
+        wire_delta = saved.get("wire_dtype") != here.get("wire_dtype")
+        if saved_ch != here_ch or wire_delta:
+            detail = (f" (exchange method {saved_m} -> {here_m})"
+                      if saved_m != here_m else "")
+            if wire_delta:
+                detail += (f" (wire_dtype {saved.get('wire_dtype')} -> "
+                           f"{here.get('wire_dtype')}: halos exchanged "
+                           "after restore round to the NEW wire precision)")
             log.warn(
                 "ckpt: snapshot was written under exchange plan "
-                f"{saved['choice']} but this run uses {here['choice']} — "
-                "the elastic restore is still bit-exact, but the compiled "
-                "programs differ; re-tune (--autotune) or pass the "
-                "snapshot's plan to keep measurements comparable"
+                f"{saved.get('choice')} but this run uses {here['choice']}"
+                f"{detail}{unknown} — the elastic restore is still "
+                "bit-exact, but the compiled programs differ; re-tune "
+                "(--autotune) or pass the snapshot's plan to keep "
+                "measurements comparable"
             )
 
     # -- checkpoint / restart (ckpt/ subsystem) ------------------------------

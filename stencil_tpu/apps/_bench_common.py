@@ -127,6 +127,7 @@ def time_exchange(
     prefix: str = "",
     batch_quantities: bool = True,
     partition=None,
+    wire_dtype=None,
 ) -> dict:
     """Realize a domain with ``quantities`` quantities and time ``iters``
     exchanges in fused chunks. Returns stats + the domain.
@@ -134,12 +135,15 @@ def time_exchange(
     ``batch_quantities=False`` times the historical
     one-collective-per-quantity program (the ``--batched-ab`` baseline);
     ``partition`` forces the block grid (e.g. ``(2, 2, 2)``) so A/B runs
-    pin the mesh instead of trusting the auto-partitioner."""
+    pin the mesh instead of trusting the auto-partitioner; ``wire_dtype``
+    turns on the (lossy) bf16-on-the-wire carrier compression."""
     devices = list(devices) if devices is not None else jax.devices()
     dd = DistributedDomain(size.x, size.y, size.z)
     dd.set_radius(radius)
     dd.set_methods(method)
     dd.set_quantity_batching(batch_quantities)
+    if wire_dtype:
+        dd.set_wire_dtype(wire_dtype)
     if partition is not None:
         dd.set_partition(partition)
     dd.set_devices(devices)
@@ -159,9 +163,12 @@ def time_exchange(
     loops = {chunk: dd.halo_exchange.make_loop(chunk)}
     if tail:
         loops[tail] = dd.halo_exchange.make_loop(tail)
+    # the wire tag keeps a --wire-ab run's legs separable in aggregation
+    # (report._agg_key splits on it, like method/batched)
+    wtag = {"wire": str(wire_dtype)} if wire_dtype else {}
     # compile + warm every loop size OUTSIDE the timed region
     with rec.span("exchange.warmup", phase="compile", method=method.value,
-                  batched=batch_quantities):
+                  batched=batch_quantities, **wtag):
         for fn in loops.values():
             state = fn(state)
         hard_sync(state)
@@ -174,7 +181,8 @@ def time_exchange(
         # gauges: without it the permutes_per_quantity tripwire would
         # average the batched leg with its per-quantity baseline
         census = telemetry.record_exchange_truth(
-            dd.halo_exchange, state, itemsizes, batched=batch_quantities)
+            dd.halo_exchange, state, itemsizes, batched=batch_quantities,
+            **wtag)
 
     stats = Statistics()
     done = 0
@@ -186,16 +194,19 @@ def time_exchange(
         per = (time.perf_counter() - t0) / k
         stats.insert(per)
         rec.emit("span", "exchange.iter", phase="exchange", seconds=per,
-                 iters=k, method=method.value, batched=batch_quantities)
+                 iters=k, method=method.value, batched=batch_quantities,
+                 **wtag)
         done += k
     dd._curr = dict(state)  # the loops donated the original buffers
     if rec.enabled:
         rec.gauge("exchange.trimean_s", stats.trimean(), phase="exchange",
-                  unit="s", method=method.value, batched=batch_quantities)
+                  unit="s", method=method.value, batched=batch_quantities,
+                  **wtag)
         rec.gauge(
             "exchange.gb_per_s",
             dd.halo_exchange.bytes_logical(itemsizes) / stats.trimean() / 1e9,
             phase="exchange", method=method.value, batched=batch_quantities,
+            **wtag,
         )
     return {
         "domain": dd,

@@ -34,8 +34,10 @@ from ._bench_common import (
     add_metrics_flags, coord_state, start_metrics, time_exchange,
 )
 
-# ablation order: manual composed, manual direct, partitioner-synthesized
-ABLATE_METHODS = (Method.AXIS_COMPOSED, Method.DIRECT26, Method.AUTO_SPMD)
+# ablation order: manual composed, manual direct, partitioner-synthesized,
+# kernel-initiated (remote DMA — 0 ppermutes; CPU runs the emulation)
+ABLATE_METHODS = (Method.AXIS_COMPOSED, Method.DIRECT26, Method.AUTO_SPMD,
+                  Method.REMOTE_DMA)
 
 
 def sweep_radii(face: int = 2, edge: int = 1):
@@ -64,13 +66,13 @@ def sweep_radii(face: int = 2, edge: int = 1):
 
 
 def run(x, y, z, iters=30, quantities=4, devices=None, method=Method.AXIS_COMPOSED,
-        chunk=10):
+        chunk=10, wire_dtype=None):
     devices = list(devices) if devices is not None else jax.devices()
     rows = []
     for name, radius in sweep_radii():
         r = time_exchange(
             Dim3(x, y, z), radius, iters, method=method, devices=devices,
-            quantities=quantities, chunk=chunk,
+            quantities=quantities, chunk=chunk, wire_dtype=wire_dtype,
         )
         rows.append(
             {
@@ -229,6 +231,90 @@ def batched_ab(x, y, z, iters=30, quantities=(1, 4, 8), devices=None,
     return rows, q_independent, parity
 
 
+def wire_ab(x, y, z, iters=30, quantities=4, devices=None, radius=2,
+            wire="bfloat16", method=Method.AXIS_COMPOSED, partition=None):
+    """bf16-on-the-wire A/B: the same exchange with native carriers vs
+    ``wire``-compressed ones, reporting the on-wire byte reduction and
+    the measured error the compression pays for it.
+
+    Bytes come from :func:`~stencil_tpu.utils.hlo_check.stablehlo_wire_census`
+    over each leg's LOWERED program — the pre-backend-optimization truth.
+    (The compiled-HLO census is still recorded when metrics are on, but
+    the CPU backend's float-normalization pass widens bf16 collectives
+    back to f32, so only a TPU's compiled census can confirm the ratio
+    in silicon; the lowered module is what the exchange asks the wire to
+    carry, and is exact for the hand-written permute methods.)
+
+    Error gauges (vs the full-precision leg, on coordinate fields):
+    ``wire_ab.max_abs_err``, ``wire_ab.max_rel_err`` and
+    ``wire_ab.max_ulp_err`` (float32 ULPs between the two results).
+    Returns ``(rows, bytes_ratio, err)``."""
+    from ..utils.hlo_check import stablehlo_wire_census
+
+    if method == Method.AUTO_SPMD:
+        raise ValueError(
+            "--wire-ab has no meaning for auto-spmd: the partitioner owns "
+            "the schedule and packs no carriers to compress"
+        )
+    devices = list(devices) if devices is not None else jax.devices()
+    rec = telemetry.get()
+    rows = []
+    outs = {}
+    wire_bytes = {}
+    for wd in (None, wire):
+        r = time_exchange(
+            Dim3(x, y, z), Radius.constant(radius), iters, method=method,
+            devices=devices, quantities=quantities, wire_dtype=wd,
+            partition=partition,
+        )
+        dd = r["domain"]
+        ex = dd.halo_exchange
+        state = coord_state(dd, quantities)
+        # the lowered-module wire truth (see docstring); REMOTE_DMA has
+        # no single lowered program — its wire bytes come from the plan
+        if method == Method.REMOTE_DMA:
+            itemsizes = [np.dtype("float32").itemsize] * quantities
+            wire_bytes[wd] = ex.plan.wire_bytes(itemsizes)
+            cp = (0, wire_bytes[wd])
+        else:
+            census = stablehlo_wire_census(
+                ex._compiled.lower(state).as_text())
+            cp = census.get("collective-permute", (0, 0))
+            wire_bytes[wd] = cp[1]
+        label = f"wire={wd or 'native'}"
+        rows.append({
+            "config": f"{x}-{y}-{z}/q={quantities}/{label}",
+            "bytes": r["bytes_logical"],
+            "trimean_s": r["trimean_s"],
+            "bytes_per_s": r["bytes_logical"] / r["trimean_s"],
+            "cp_count": cp[0],
+            "cp_bytes": cp[1],
+            "other_collectives": 0,
+        })
+        out = ex(state)
+        outs[wd] = np.stack(
+            [np.asarray(jax.device_get(out[i])) for i in sorted(out)]
+        )
+    ratio = (wire_bytes[None] / wire_bytes[wire]
+             if wire_bytes[wire] else 0.0)
+    a, b = outs[None].astype(np.float32), outs[wire].astype(np.float32)
+    abs_err = float(np.max(np.abs(a - b)))
+    rel_err = float(np.max(np.abs(a - b) / np.maximum(np.abs(a), 1.0)))
+    # ULP distance in float32: adjacent-representable steps between the
+    # two results (monotone int reinterpretation; same-sign values here)
+    ai = a.view(np.int32).astype(np.int64)
+    bi = b.view(np.int32).astype(np.int64)
+    ulp_err = float(np.max(np.abs(ai - bi)))
+    err = {"max_abs_err": abs_err, "max_rel_err": rel_err,
+           "max_ulp_err": ulp_err}
+    if rec.enabled:
+        rec.gauge("wire_ab.bytes_ratio", ratio, phase="verify", wire=wire)
+        rec.gauge("wire_ab.max_abs_err", abs_err, phase="verify", wire=wire)
+        rec.gauge("wire_ab.max_rel_err", rel_err, phase="verify", wire=wire)
+        rec.gauge("wire_ab.max_ulp_err", ulp_err, phase="verify", wire=wire)
+    return rows, ratio, err
+
+
 def report_header() -> str:
     return "config,bytes,trimean (s),B/s"
 
@@ -276,7 +362,19 @@ def main(argv: Optional[list] = None) -> int:
                         "is Q-independent and results agree bit-for-bit")
     p.add_argument("--partition", default="",
                    help="force the partition grid as XxYxZ (e.g. 2x2x2) "
-                        "for --batched-ab")
+                        "for --batched-ab / --wire-ab")
+    p.add_argument("--wire-ab", action="store_true",
+                   help="run ONLY the bf16-on-the-wire A/B: native vs "
+                        "--wire-dtype compressed carriers, with on-wire "
+                        "byte columns (lowered-module census) and the "
+                        "measured max abs/rel/ulp error vs full precision; "
+                        "exit 1 unless the byte reduction is >= 1.9x and "
+                        "the error sits within the wire dtype's rounding "
+                        "bound")
+    p.add_argument("--wire-dtype", default="",
+                   help="wire-compression dtype: the radius sweep runs "
+                        "with it on; --wire-ab A/Bs it against native "
+                        "(default bfloat16 there)")
     p.add_argument("--cpu", type=int, default=0)
     add_metrics_flags(p)
     args = p.parse_args(argv)
@@ -285,6 +383,34 @@ def main(argv: Optional[list] = None) -> int:
         jax.config.update("jax_num_cpu_devices", args.cpu)
     start_metrics(args, "bench_exchange")
     qs = [int(t) for t in str(args.quantities).split(",") if t.strip()]
+    if args.wire_ab:
+        partition = None
+        if args.partition:
+            partition = tuple(int(t) for t in args.partition.split("x"))
+        if len(qs) > 1:
+            p.error("--wire-ab takes a single --quantities value")
+        wire = args.wire_dtype or "bfloat16"
+        rows, ratio, err = wire_ab(
+            args.x, args.y, args.z, iters=args.iters,
+            quantities=qs[0] if qs else 4, wire=wire,
+            method=Method(args.method), partition=partition,
+        )
+        print(ablate_header())
+        for row in rows:
+            print(ablate_row(row))
+        print(f"# on-wire byte reduction ({wire}): {ratio:.3f}x")
+        print(f"# max abs err {err['max_abs_err']:.6g}  max rel err "
+              f"{err['max_rel_err']:.3e}  max f32-ulp err "
+              f"{err['max_ulp_err']:.0f}")
+        # rounding bound: half-ulp of the wire dtype's mantissa, in
+        # relative terms (bf16: 8 mantissa bits incl. implicit -> 2^-8)
+        mant = np.finfo(np.dtype(wire) if wire != "bfloat16"
+                        else np.float32).nmant
+        rel_bound = 2.0 ** -(8 if wire == "bfloat16" else mant + 1)
+        ok = ratio >= 1.9 and err["max_rel_err"] <= rel_bound
+        print(f"# wire A/B gate (>=1.9x bytes, rel err <= {rel_bound:g}): "
+              f"{'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
     if args.batched_ab:
         partition = None
         if args.partition:
@@ -316,7 +442,8 @@ def main(argv: Optional[list] = None) -> int:
         return 0 if agree and len(rows) == len(ABLATE_METHODS) else 1
     print(report_header())
     for row in run(args.x, args.y, args.z, iters=args.iters,
-                   method=Method(args.method), quantities=nq):
+                   method=Method(args.method), quantities=nq,
+                   wire_dtype=args.wire_dtype or None):
         print(report_row(row))
     if args.methods:
         for row in compare_methods(args.x, args.y, args.z, iters=args.iters,

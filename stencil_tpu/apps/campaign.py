@@ -60,7 +60,8 @@ def build_jobs(args) -> list:
 
     return [
         TenantJob(f"t{i}", (args.size, args.size, args.size), args.steps,
-                  args.dtype, seed=args.init_seed + i)
+                  args.dtype, seed=args.init_seed + i,
+                  workload=args.workload)
         for i in range(args.tenants)
     ]
 
@@ -165,6 +166,12 @@ def main(argv: Optional[list] = None) -> int:
                    help="steps per tenant")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "float64"])
+    p.add_argument("--workload", choices=["jacobi", "astaroth"],
+                   default="jacobi",
+                   help="tenant physics: jacobi (single-quantity heat) or "
+                        "astaroth (8-field MHD via the batched RK3 step); "
+                        "astaroth serves --mode batched only (its "
+                        "sequential baseline is a B=1 slot)")
     p.add_argument("--chunk", type=int, default=2,
                    help="fused steps per dispatch")
     p.add_argument("--mode", choices=["batched", "sequential", "ab"],
@@ -212,6 +219,13 @@ def main(argv: Optional[list] = None) -> int:
         jax.config.update("jax_num_cpu_devices", args.cpu)
     if args.dtype == "float64":
         jax.config.update("jax_enable_x64", True)
+    if args.workload == "astaroth" and args.mode != "batched":
+        p.error("--workload astaroth serves --mode batched only (the "
+                "sequential baseline is a B=1 slot through the driver)")
+    if args.workload == "astaroth" and args.use_pallas:
+        p.error("--workload astaroth runs the XLA batched step; the "
+                "batched Pallas astaroth substep is a hardware-session "
+                "follow-up (drop --use-pallas)")
     rec = start_metrics(args, "campaign")
 
     campaign_dir = args.campaign_dir or tempfile.mkdtemp(prefix="campaign-")

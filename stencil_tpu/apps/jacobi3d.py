@@ -78,6 +78,7 @@ def run(
     max_rollbacks: int = 3,
     rollback_backoff: float = 0.25,
     inject: Optional[str] = None,
+    wire_dtype: Optional[str] = None,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -109,7 +110,9 @@ def run(
     if (pdim is not None and pdim.x == 1 and pdim.flatten() == n
             and size.x % 128 == 0
             and size.y % pdim.y == 0 and size.z % pdim.z == 0
-            and method != Method.AUTO_SPMD  # no in-kernel x wrap globally
+            # no in-kernel x wrap in the global AUTO_SPMD program, and the
+            # REMOTE_DMA carrier/emulation assumes inline halos everywhere
+            and method not in (Method.AUTO_SPMD, Method.REMOTE_DMA)
             and not autotune  # the tuner may pick AUTO_SPMD, which cannot
                               # run the tight-x no-x-halo layout
             and all(d.platform == "tpu" for d in devices)):
@@ -127,6 +130,8 @@ def run(
         dd.set_radius(deep_halo)
     dd.set_methods(method)
     dd.set_devices(devices)
+    if wire_dtype:
+        dd.set_wire_dtype(wire_dtype)
     if partition is not None:
         dd.set_partition(partition)
     if autotune:
@@ -474,6 +479,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--plan-db", type=str, default="",
                    help="on-disk plan DB (JSON) for --autotune; also "
                         "inspectable via apps/plan_tool.py")
+    p.add_argument("--wire-dtype", type=str, default="",
+                   help="bf16-on-the-wire halo compression: wire-crossing "
+                        "exchange carriers narrow to this dtype (LOSSY — "
+                        "halos round to the wire precision; "
+                        "bench_exchange --wire-ab measures the error)")
     p.add_argument("--prefix", type=str, default="")
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
     p.add_argument("--deep-halo", type=int, default=1,
@@ -533,6 +543,7 @@ def main(argv: Optional[list] = None) -> int:
             max_rollbacks=args.max_rollbacks,
             rollback_backoff=args.rollback_backoff,
             inject=args.inject or None,
+            wire_dtype=args.wire_dtype or None,
         )
     except RecoveryExhausted as e:
         # the loud-degrade contract: evidence bundle on disk, the distinct

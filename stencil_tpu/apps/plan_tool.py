@@ -96,18 +96,32 @@ def cmd_explain(args) -> int:
     ranked = rank(config, enumerate_candidates(config))
     print(f"static ranking ({len(ranked)} feasible candidates):")
     for cost, choice in ranked[: args.top]:
+        extra = (f" dmas={cost.dmas}" if choice.method == "remote-dma"
+                 else "")
         print(f"  {choice.label():45s} {cost.total_s * 1e3:9.3f} ms/step  "
-              f"permutes={cost.collectives} wire={cost.wire_bytes}")
-    best = (PlanChoice.from_json(entry["choice"]) if entry is not None
-            else ranked[0][1] if ranked else None)
+              f"permutes={cost.collectives} wire={cost.wire_bytes}{extra}")
+    if args.method:
+        # explain one method's plan IR explicitly (e.g. remote-dma with
+        # its 0-ppermute census prediction, DMA count, and the
+        # wire_dtype-compressed byte model) instead of the ranked best
+        best = next((ch for _c, ch in ranked if ch.method == args.method),
+                    None)
+        if best is None:
+            print(f"no feasible {args.method} candidate for this config")
+            return 1
+    else:
+        best = (PlanChoice.from_json(entry["choice"]) if entry is not None
+                else ranked[0][1] if ranked else None)
     if best is not None:
         feas = feasible(config, best)
         if feas is not None:
             spec, mesh_dim, resident = feas
             plan = build_plan(spec, mesh_dim, best.method,
-                              best.batch_quantities, resident)
+                              best.batch_quantities, resident,
+                              wire_dtype=args.wire_dtype or None)
             print("plan IR of the "
-                  + ("DB" if entry is not None else "best static")
+                  + (f"requested {args.method}" if args.method
+                     else "DB" if entry is not None else "best static")
                   + " choice:")
             print(plan.describe())
     return 0
@@ -173,14 +187,19 @@ def cmd_autotune(args) -> int:
     start_metrics(args, "plan_tool")
     from ..geometry import Dim3, Radius
     from ..plan.autotune import autotune
+    from ..plan.ir import METHODS
 
+    methods = tuple(t for t in args.methods.split(",") if t) or METHODS
+    for m in methods:
+        if m not in METHODS:
+            raise SystemExit(f"unknown method {m!r} (choose from {METHODS})")
     res = autotune(
         Dim3(args.x, args.y, args.z), Radius.constant(args.radius),
         [args.dtype] * args.quantities,
         devices=jax.devices()[: args.ndev] if args.ndev else None,
         db_path=args.db or None, top_n=args.top_n,
         probe_iters=args.probe_iters, probe=not args.no_probe,
-        force=args.force,
+        force=args.force, methods=methods,
     )
     print(f"chosen: {res.choice.label()}")
     print(f"source: {res.source}  cache_hit: {res.cache_hit}  "
@@ -208,6 +227,14 @@ def main(argv: Optional[list] = None) -> int:
                         help="DB entry + static ranking + plan IR of one config")
     sp.add_argument("--db", default="")
     sp.add_argument("--top", type=int, default=8)
+    sp.add_argument("--method", default="",
+                    choices=("",) + plandb.METHODS,
+                    help="dump THIS method's plan IR (e.g. remote-dma: "
+                         "0-ppermute prediction + DMA count) instead of "
+                         "the ranked best")
+    sp.add_argument("--wire-dtype", default="",
+                    help="render the plan's wire bytes under this "
+                         "wire-compression dtype (e.g. bfloat16)")
     _add_config_flags(sp)
 
     sp = sub.add_parser("prune", help="drop entries by filter")
@@ -233,6 +260,10 @@ def main(argv: Optional[list] = None) -> int:
                     help="static ranking only (no compiles)")
     sp.add_argument("--force", action="store_true",
                     help="re-tune through an existing DB entry")
+    sp.add_argument("--methods", default="",
+                    help="comma list restricting the searched exchange "
+                         "methods (e.g. 'remote-dma' to tune/persist a "
+                         "remote-dma-keyed entry); default: all")
     _add_config_flags(sp)
     from ._bench_common import add_metrics_flags
 

@@ -81,8 +81,11 @@ def _agg_key(rec: dict) -> str:
     and averaging them would read as neither. ``mode`` is the campaign
     A/B's tag (``campaign.step_latency_s`` carries batched AND sequential
     samples in one ab run — a folded p99 would describe neither leg)."""
+    # ``wire`` splits the bf16-on-the-wire A/B (bench_exchange --wire-ab):
+    # the compressed and native legs' timings/census differ by design
     name = rec["name"]
-    tags = [str(rec[t]) for t in ("method", "batched", "mode") if t in rec]
+    tags = [str(rec[t])
+            for t in ("method", "batched", "mode", "wire") if t in rec]
     if tags:
         return f"{name}[{','.join(tags)}]"
     return name

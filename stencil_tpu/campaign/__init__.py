@@ -14,10 +14,12 @@ The user-facing surface is ``apps/campaign.py`` and the tracked
 
 from .compile_cache import CompileCache, cache_key  # noqa: F401
 from .driver import (  # noqa: F401
+    WORKLOADS,
     CampaignDriver,
     Lane,
     TenantJob,
     TenantResult,
+    astaroth_init_state,
     batch_devices,
     plan_slots,
     run_sequential,

@@ -77,18 +77,23 @@ QUANTITY = "temperature"
 
 @dataclass
 class TenantJob:
-    """One queued simulation: an independent periodic jacobi box."""
+    """One queued simulation: an independent periodic box of one
+    workload — ``"jacobi"`` (single-quantity heat) or ``"astaroth"``
+    (8-field MHD through ``make_batched_astaroth_step``)."""
 
     tid: str
     size: Tuple[int, int, int]      # (x, y, z)
     steps: int
     dtype: str = "float32"
     seed: int = 0
+    workload: str = "jacobi"
 
-    def bucket(self) -> Tuple[Tuple[int, int, int], str]:
+    def bucket(self) -> Tuple[Tuple[int, int, int], str, str]:
         """The shape bucket: jobs in one slot must share it (the compiled
-        program and the compile-cache key depend on nothing else)."""
-        return (tuple(int(v) for v in self.size), str(self.dtype))
+        program and the compile-cache key depend on nothing else).
+        Workload joins the bucket — a slot's program is the workload's."""
+        return (tuple(int(v) for v in self.size), str(self.dtype),
+                str(self.workload))
 
 
 @dataclass
@@ -98,7 +103,9 @@ class TenantResult:
     steps: int                      # tenant steps completed
     snapshot_dir: str
     evidence: Optional[str] = None
-    final: Optional[np.ndarray] = None   # global [z,y,x] interior ("done")
+    final: Optional[np.ndarray] = None   # global [z,y,x] interior ("done",
+    #                                      the workload's FIRST quantity)
+    finals: Optional[Dict[str, np.ndarray]] = None  # every quantity ("done")
 
 
 @dataclass
@@ -131,6 +138,105 @@ def tenant_init_field(job: TenantJob) -> np.ndarray:
     rng = np.random.RandomState(job.seed & 0x7FFFFFFF)
     f = INIT_TEMP + 0.05 * rng.standard_normal((z, y, x))
     return f.astype(job.dtype)
+
+
+def astaroth_init_state(job: TenantJob) -> Dict[str, np.ndarray]:
+    """The one authority for an astaroth tenant's step-0 fields: small
+    seeded perturbations per field, lnrho offset to a positive density —
+    the same fixture shape the batched-step parity suite uses. Any code
+    path (driver, revival, parity tests) regenerates a tenant from
+    this."""
+    from ..astaroth.integrate import FIELDS
+
+    x, y, z = job.size
+    rng = np.random.RandomState((job.seed ^ 0x5A57A407) & 0x7FFFFFFF)
+    state = {}
+    for k in FIELDS:
+        f = rng.standard_normal((z, y, x)) * 0.05
+        if k == "lnrho":
+            f = f + 0.5
+        state[k] = f.astype(job.dtype)
+    return state
+
+
+class _JacobiWorkload:
+    """The original campaign workload: single-quantity periodic heat."""
+
+    name = "jacobi"
+    default_radius = 1
+    needs_sel = True
+
+    def quantity_names(self, job_dtype: str):
+        return [QUANTITY]
+
+    def init_state(self, job: TenantJob) -> Dict[str, np.ndarray]:
+        return {QUANTITY: tenant_init_field(job)}
+
+    def build_loop(self, spec, iters: int, sharding, sel_sharding,
+                   batch: int, use_pallas: bool):
+        return make_batched_jacobi_loop(
+            spec, iters, sharding=sharding, sel_sharding=sel_sharding,
+            use_pallas=use_pallas, batch=batch if use_pallas else None)
+
+    def step(self, loop, state: Dict, scratch: Dict, sel) -> Dict:
+        c, _scratch = loop(state[QUANTITY], scratch[QUANTITY], sel)
+        return {QUANTITY: c}
+
+
+class _AstarothWorkload:
+    """8-field MHD tenants through ``make_batched_astaroth_step`` —
+    the ROADMAP #4 follow-up: the batched astaroth step existed (PR 9);
+    this routes whole astaroth campaigns through the same queue/slot/
+    guard/evict machinery the jacobi tenants use. No sel (no sphere
+    sources), radius 3 (6th-order cross stencils), one reference
+    swap-per-iteration RK3 step per slot step."""
+
+    name = "astaroth"
+    default_radius = 3
+    needs_sel = False
+    dt = 1e-8
+
+    def quantity_names(self, job_dtype: str):
+        from ..astaroth.integrate import FIELDS
+
+        return list(FIELDS)
+
+    def init_state(self, job: TenantJob) -> Dict[str, np.ndarray]:
+        return astaroth_init_state(job)
+
+    def _info(self, spec):
+        from ..astaroth import config as ac_config
+
+        info = ac_config.AcMeshInfo()
+        conf = os.path.join(os.path.dirname(__file__), "..", "astaroth",
+                            "astaroth.conf")
+        with open(conf) as f:
+            ac_config.parse_config(f.read(), info)
+        b = spec.base
+        info.int_params["AC_nx"] = int(b.x)
+        info.int_params["AC_ny"] = int(b.y)
+        info.int_params["AC_nz"] = int(b.z)
+        info.update_builtin_params()
+        return info
+
+    def build_loop(self, spec, iters: int, sharding, sel_sharding,
+                   batch: int, use_pallas: bool):
+        from ..astaroth.integrate import make_batched_astaroth_step
+
+        assert not use_pallas, (
+            "astaroth campaigns run the XLA batched step (the batched "
+            "Pallas substep is a hardware-session follow-up)"
+        )
+        return make_batched_astaroth_step(spec, self._info(spec),
+                                          dt=self.dt, iters=iters,
+                                          sharding=sharding)
+
+    def step(self, loop, state: Dict, scratch: Dict, sel) -> Dict:
+        curr, _out = loop(state, scratch)
+        return curr
+
+
+WORKLOADS = {"jacobi": _JacobiWorkload(), "astaroth": _AstarothWorkload()}
 
 
 def pick_slot(queue: deque,
@@ -182,7 +288,7 @@ class CampaignDriver:
         campaign_dir: str,
         *,
         devices: Optional[Sequence] = None,
-        radius: int = 1,
+        radius: Optional[int] = None,
         chunk: int = 2,
         ckpt_every: int = 0,
         ckpt_keep: int = 3,
@@ -204,7 +310,14 @@ class CampaignDriver:
         self.campaign_dir = campaign_dir
         self.devices = (list(devices) if devices is not None
                         else jax.devices())
-        self.radius = int(radius)
+        # None = each slot uses its workload's default (jacobi 1,
+        # astaroth 3 — the 6th-order cross stencils)
+        self.radius = None if radius is None else int(radius)
+        for j in self.jobs:
+            if j.workload not in WORKLOADS:
+                raise ValueError(
+                    f"tenant {j.tid}: unknown workload {j.workload!r} "
+                    f"(known: {sorted(WORKLOADS)})")
         self.chunk = max(1, int(chunk))
         self.ckpt_every = int(ckpt_every)
         self.ckpt_keep = int(ckpt_keep)
@@ -223,29 +336,35 @@ class CampaignDriver:
         return os.path.join(self.campaign_dir, "tenants", tid)
 
     def _write_tenant_snapshot(self, job: TenantJob, spec: GridSpec,
-                               lane_state: np.ndarray, step: int) -> None:
+                               lane_state: Dict[str, np.ndarray],
+                               step: int) -> None:
         p = spec.padded()
-        arr6 = np.ascontiguousarray(
-            lane_state.reshape(1, 1, 1, p.z, p.y, p.x))
-        write_snapshot(self.tenant_dir(job.tid), step, spec,
-                       {QUANTITY: arr6}, dtypes={QUANTITY: job.dtype},
+        arrs = {
+            name: np.ascontiguousarray(a.reshape(1, 1, 1, p.z, p.y, p.x))
+            for name, a in lane_state.items()
+        }
+        write_snapshot(self.tenant_dir(job.tid), step, spec, arrs,
+                       dtypes={name: job.dtype for name in arrs},
                        keep=self.ckpt_keep)
 
-    def _resume_tenant(self, job: TenantJob) -> Optional[Tuple[int, np.ndarray]]:
+    def _resume_tenant(self, job: TenantJob
+                       ) -> Optional[Tuple[int, Dict[str, np.ndarray]]]:
         """The newest valid compatible snapshot of a revived tenant:
-        ``(tenant_step, global [z,y,x])`` or None (fresh start)."""
+        ``(tenant_step, {quantity: global [z,y,x]})`` or None (fresh)."""
         if not self.resume:
             return None
+        names = WORKLOADS[job.workload].quantity_names(job.dtype)
         x, y, z = job.size
         found = find_resume(
             self.tenant_dir(job.tid),
             accept=lambda m: check_compatible(
-                m, Dim3(x, y, z), [QUANTITY], [job.dtype]),
+                m, Dim3(x, y, z), names, [job.dtype] * len(names)),
         )
         if found is None:
             return None
         snap, manifest = found
-        g = assemble_global(snap, manifest, QUANTITY, dtype=job.dtype)
+        g = {name: assemble_global(snap, manifest, name, dtype=job.dtype)
+             for name in names}
         log.info(f"campaign: revived tenant {job.tid} from step "
                  f"{manifest['step']} ({snap})")
         return int(manifest["step"]), g
@@ -255,21 +374,22 @@ class CampaignDriver:
               sel_sharding, devs: Sequence):
         from ..plan.ir import PlanConfig
 
-        (size, dtype) = bucket
-        cfg = PlanConfig.make(Dim3(*size), spec.radius, [dtype], len(devs),
-                              self.devices[0].platform)
+        (size, dtype, workload) = bucket
+        wl = WORKLOADS[workload]
+        nq = len(wl.quantity_names(dtype))
+        cfg = PlanConfig.make(Dim3(*size), spec.radius, [dtype] * nq,
+                              len(devs), self.devices[0].platform)
         # device IDENTITY joins the key, not just the count: the jitted
         # loop's in_shardings pin a concrete mesh, and a shared cache
         # serving two drivers on disjoint same-sized device sets must
         # never hand one the other's program
-        key = cache_key(cfg, workload="jacobi-batched",
+        key = cache_key(cfg, workload=f"{workload}-batched",
                         batch=self.slot_size, iters=int(iters),
                         pallas=self.use_pallas,
                         devices=[d.id for d in devs])
-        return self.cache.get(key, lambda: make_batched_jacobi_loop(
-            spec, iters, sharding=sharding, sel_sharding=sel_sharding,
-            use_pallas=self.use_pallas,
-            batch=self.slot_size if self.use_pallas else None))
+        return self.cache.get(key, lambda: wl.build_loop(
+            spec, iters, sharding, sel_sharding,
+            batch=self.slot_size, use_pallas=self.use_pallas))
 
     # -- the campaign ---------------------------------------------------------
     def run(self) -> dict:
@@ -312,11 +432,15 @@ class CampaignDriver:
     def _run_slot(self, slot_idx: int, bucket, initial: List[TenantJob],
                   queue: deque, results: Dict[str, TenantResult]) -> dict:
         rec = telemetry.get()
-        (size, dtype) = bucket
+        (size, dtype, workload) = bucket
+        wl = WORKLOADS[workload]
+        names = wl.quantity_names(dtype)
+        radius = (self.radius if self.radius is not None
+                  else wl.default_radius)
         x, y, z = size
         cells = x * y * z
         spec = GridSpec(Dim3(x, y, z), Dim3(1, 1, 1),
-                        Radius.constant(self.radius),
+                        Radius.constant(radius),
                         aligned=self.use_pallas)
         p = spec.padded()
         off = spec.compute_offset()
@@ -328,48 +452,66 @@ class CampaignDriver:
         sh = NamedSharding(mesh, P("b"))
         shr = NamedSharding(mesh, P())
 
-        # sel: the standard hot/cold spheres, shared across lanes (every
-        # tenant of one bucket sees the same geometry); the Pallas path
-        # wants the per-tenant stacked layout its kernel indexes
-        sel_np = np.zeros((p.z, p.y, p.x), np.int32)
-        sel_np[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x] = (
-            sphere_sel((x, y, z)))
-        if self.use_pallas:
-            sel = jax.device_put(
-                jnp.asarray(np.broadcast_to(sel_np, (B,) + sel_np.shape)
-                            .copy()), sh)
-            sel_sh = sh
-        else:
-            sel = jax.device_put(jnp.asarray(sel_np), shr)
-            sel_sh = shr
+        # sel (jacobi only): the standard hot/cold spheres, shared across
+        # lanes (every tenant of one bucket sees the same geometry); the
+        # Pallas path wants the per-tenant stacked layout its kernel
+        # indexes. Astaroth has no source geometry — no sel at all.
+        sel = None
+        sel_sh = shr
+        if wl.needs_sel:
+            sel_np = np.zeros((p.z, p.y, p.x), np.int32)
+            sel_np[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x] = (
+                sphere_sel((x, y, z)))
+            if self.use_pallas:
+                sel = jax.device_put(
+                    jnp.asarray(np.broadcast_to(sel_np, (B,) + sel_np.shape)
+                                .copy()), sh)
+                sel_sh = sh
+            else:
+                sel = jax.device_put(jnp.asarray(sel_np), shr)
+                sel_sh = shr
 
         lanes = [Lane(i) for i in range(B)]
 
-        def lane_init(job: TenantJob) -> Tuple[int, np.ndarray]:
+        def interior(padded: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            return {
+                name: np.ascontiguousarray(
+                    a[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x])
+                for name, a in padded.items()
+            }
+
+        def lane_init(job: TenantJob) -> Tuple[int, Dict[str, np.ndarray]]:
             revived = self._resume_tenant(job)
             t0_step, g = revived if revived is not None else (
-                0, tenant_init_field(job))
-            padded = np.zeros((p.z, p.y, p.x), dtype)
-            padded[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x] = g
+                0, wl.init_state(job))
+            padded = {}
+            for name in names:
+                a = np.zeros((p.z, p.y, p.x), dtype)
+                a[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x] = g[name]
+                padded[name] = a
             return t0_step, padded
 
-        curr_np = np.zeros((B, p.z, p.y, p.x), dtype)
+        curr_np = {name: np.zeros((B, p.z, p.y, p.x), dtype)
+                   for name in names}
         for i, job in enumerate(initial):
             t0_step, padded = lane_init(job)
             if t0_step >= job.steps:
                 # revived past its target: report done, leave the lane to
                 # a later backfill pass
-                g = padded[off.z:off.z + z, off.y:off.y + y, off.x:off.x + x]
+                fins = interior(padded)
                 results[job.tid] = TenantResult(
                     job.tid, "done", job.steps, self.tenant_dir(job.tid),
-                    final=np.ascontiguousarray(g))
+                    final=fins[names[0]], finals=fins)
                 continue
             lanes[i].tenant = job
             lanes[i].start_slot_step = 0
             lanes[i].start_tenant_step = t0_step
-            curr_np[i] = padded
-        curr = jax.device_put(jnp.asarray(curr_np), sh)
-        nxt0 = jax.device_put(jnp.zeros_like(curr), sh)
+            for name in names:
+                curr_np[name][i] = padded[name]
+        curr = {name: jax.device_put(jnp.asarray(a), sh)
+                for name, a in curr_np.items()}
+        scratch = {name: jax.device_put(jnp.zeros_like(curr[name]), sh)
+                   for name in names}
         del curr_np
 
         guard = SlotHealthGuard(every=self.health_every, max_abs=self.max_abs)
@@ -388,12 +530,14 @@ class CampaignDriver:
                                                        for j in self.jobs])
         rec.meta("campaign.slot", slot=slot_idx,
                  tenants=[l.tenant.tid for l in lanes if l.tenant],
-                 bucket={"size": list(size), "dtype": dtype},
+                 bucket={"size": list(size), "dtype": dtype,
+                         "workload": workload},
                  devices=len(devs))
 
-        def backfill(lane: Lane, slot_step: int, state_arr):
+        def backfill(lane: Lane, slot_step: int, state: Dict):
             """Replace a retired/evicted lane from the queue (same bucket
-            only) or mark it dead (zeros)."""
+            only) or mark it dead (zeros). Takes and returns the whole
+            quantity dict — every quantity's lane moves together."""
             job = None
             for cand in list(queue):
                 if cand.bucket() == bucket:
@@ -402,35 +546,41 @@ class CampaignDriver:
                     break
             if job is None:
                 lane.tenant = None
-                return state_arr.at[lane.idx].set(
-                    jnp.zeros((p.z, p.y, p.x), dtype))
+                return {
+                    name: state[name].at[lane.idx].set(
+                        jnp.zeros((p.z, p.y, p.x), dtype))
+                    for name in names
+                }
             t0_step, padded = lane_init(job)
             if t0_step >= job.steps:
-                g = padded[off.z:off.z + z, off.y:off.y + y,
-                           off.x:off.x + x]
+                fins = interior(padded)
                 results[job.tid] = TenantResult(
                     job.tid, "done", job.steps, self.tenant_dir(job.tid),
-                    final=np.ascontiguousarray(g))
-                return backfill(lane, slot_step, state_arr)
+                    final=fins[names[0]], finals=fins)
+                return backfill(lane, slot_step, state)
             lane.tenant = job
             lane.start_slot_step = slot_step
             lane.start_tenant_step = t0_step
             rec.meta("campaign.backfill", tenant=job.tid, lane=lane.idx,
                      slot=slot_idx, slot_step=int(slot_step))
-            return state_arr.at[lane.idx].set(jnp.asarray(padded))
+            return {
+                name: state[name].at[lane.idx].set(
+                    jnp.asarray(padded[name]))
+                for name in names
+            }
 
         # -- the guarded slot loop -------------------------------------------
         slot_step = 0
-        stash: Tuple[int, dict] = (0, {QUANTITY: curr})
+        stash: Tuple[int, dict] = (0, dict(curr))
         lat: List[float] = []
         cell_steps = 0
         wall = 0.0
 
         def step_fn(st, k):
             loop = self._loop(spec, bucket, k, sh, sel_sh, devs)
-            c, _scratch = loop(st[QUANTITY], nxt0, sel)
-            hard_sync(c)
-            return {QUANTITY: c}
+            out = wl.step(loop, st, scratch, sel)
+            hard_sync(out)
+            return out
 
         def on_chunk(st, k, per, done_now):
             nonlocal cell_steps, wall
@@ -444,12 +594,15 @@ class CampaignDriver:
         def save_fn(s, st):
             nonlocal stash
             stash = (s, dict(st))
-            host = np.asarray(jax.device_get(st[QUANTITY]))
+            host = {name: np.asarray(jax.device_get(st[name]))
+                    for name in names}
             for l in lanes:
                 if l.tenant is None:
                     continue
-                self._write_tenant_snapshot(l.tenant, spec, host[l.idx],
-                                            l.tenant_step(s))
+                self._write_tenant_snapshot(
+                    l.tenant, spec,
+                    {name: host[name][l.idx] for name in names},
+                    l.tenant_step(s))
 
         def restore_fn():
             s, st = stash
@@ -458,7 +611,7 @@ class CampaignDriver:
         while any(l.tenant is not None for l in lanes):
             end = min(l.end_slot_step() for l in lanes
                       if l.tenant is not None)
-            state = {QUANTITY: curr}
+            state = dict(curr)
             stash = (slot_step, dict(state))
 
             def plan_fn(s):
@@ -481,27 +634,28 @@ class CampaignDriver:
                 )
             except RecoveryExhausted as e:
                 curr = self._evict(e, spec, lanes, stash, backfill,
-                                   results, slot_idx)
+                                   results, slot_idx, names)
                 slot_step = stash[0]
                 continue
             slot_step = done
-            curr = state[QUANTITY]
+            curr = dict(state)
             # segment end passed a health check (run_guarded checks at
             # done >= iters): retire every lane whose tenant is complete
-            host = np.asarray(jax.device_get(curr))
+            host = {name: np.asarray(jax.device_get(curr[name]))
+                    for name in names}
             for l in lanes:
                 if l.tenant is None:
                     continue
                 if l.tenant_step(slot_step) < l.tenant.steps:
                     continue
                 job = l.tenant
-                g = host[l.idx, off.z:off.z + z, off.y:off.y + y,
-                         off.x:off.x + x]
-                self._write_tenant_snapshot(job, spec, host[l.idx],
+                lane_host = {name: host[name][l.idx] for name in names}
+                self._write_tenant_snapshot(job, spec, lane_host,
                                             job.steps)
+                fins = interior(lane_host)
                 results[job.tid] = TenantResult(
                     job.tid, "done", job.steps, self.tenant_dir(job.tid),
-                    final=np.ascontiguousarray(g))
+                    final=fins[names[0]], finals=fins)
                 rec.meta("campaign.retire", tenant=job.tid,
                          step=int(job.steps), lane=l.idx, slot=slot_idx)
                 curr = backfill(l, slot_step, curr)
@@ -511,7 +665,7 @@ class CampaignDriver:
 
     def _evict(self, e: RecoveryExhausted, spec: GridSpec,
                lanes: List[Lane], stash, backfill, results,
-               slot_idx: int):
+               slot_idx: int, names: Sequence[str]):
         """The rc-43 eviction path: evidence moves to the tenant dir, the
         tenant's last healthy state becomes a revivable snapshot, the
         lane is backfilled, and the slot resumes from the stash."""
@@ -530,12 +684,14 @@ class CampaignDriver:
             evidence = os.path.join(tdir, "fault-evidence.json")
             shutil.move(e.evidence_path, evidence)
         sstep, sstate = stash
-        host = np.asarray(jax.device_get(sstate[QUANTITY]))
+        host = {name: np.asarray(jax.device_get(sstate[name]))
+                for name in names}
         healthy_tstep = lane.tenant_step(sstep)
         # revivable: persist the last health-checked state BEFORE the
         # lane is overwritten by the backfill
-        self._write_tenant_snapshot(job, spec, host[lane.idx],
-                                    healthy_tstep)
+        self._write_tenant_snapshot(
+            job, spec, {name: host[name][lane.idx] for name in names},
+            healthy_tstep)
         results[job.tid] = TenantResult(
             job.tid, "fault", healthy_tstep, tdir, evidence=evidence)
         rec.meta("campaign.evict", tenant=job.tid,
@@ -545,7 +701,7 @@ class CampaignDriver:
         log.warn(f"campaign: evicted tenant {job.tid} (lane {lane.idx}) "
                  f"after {e.rollbacks} rollback(s) at tenant step "
                  f"{f.tenant_step}; slot resumes from step {sstep}")
-        return backfill(lane, sstep, sstate[QUANTITY])
+        return backfill(lane, sstep, dict(sstate))
 
 
 # -- the sequential baseline ---------------------------------------------------
@@ -576,6 +732,13 @@ def run_sequential(jobs: Sequence[TenantJob], *,
     cell_steps = 0
     wall = 0.0
     t0 = time.perf_counter()
+    for j in jobs:
+        if j.workload != "jacobi":
+            raise NotImplementedError(
+                f"run_sequential serves jacobi tenants only (tenant "
+                f"{j.tid} is {j.workload!r}); the astaroth sequential "
+                "baseline is a B=1 slot through the batched driver"
+            )
 
     by_bucket: Dict[Tuple, List[TenantJob]] = {}
     order: List[Tuple] = []
@@ -587,7 +750,7 @@ def run_sequential(jobs: Sequence[TenantJob], *,
         by_bucket[b].append(j)
 
     for bucket in order:
-        (size, dtype) = bucket
+        (size, dtype, _workload) = bucket
         x, y, z = size
         cells = x * y * z
         dd = DistributedDomain(x, y, z)
@@ -627,9 +790,10 @@ def run_sequential(jobs: Sequence[TenantJob], *,
                 rec.gauge("campaign.step_latency_s", per, phase="step",
                           unit="s", mode="sequential", iters=k)
             dd.set_curr(h, c)
+            fin = np.ascontiguousarray(dd.get_curr_global(h))
             results[job.tid] = TenantResult(
-                job.tid, "done", done, "",
-                final=np.ascontiguousarray(dd.get_curr_global(h)))
+                job.tid, "done", done, "", final=fin,
+                finals={QUANTITY: fin})
 
     agg = cell_steps / wall / 1e6 if wall > 0 else 0.0
     return {

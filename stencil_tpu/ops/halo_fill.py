@@ -78,6 +78,28 @@ def unpack_slabs(carrier, nq: int):
     return [carrier] if nq == 1 else [carrier[q] for q in range(nq)]
 
 
+def wire_narrow_dtype(native, wire_dtype):
+    """The dtype a wire-crossing carrier of ``native`` data travels as
+    under the bf16-on-the-wire compression knob, or None when the
+    carrier stays native: compression only ever NARROWS a floating
+    carrier (fp32 -> bf16/f16, fp64 -> f32/bf16/...), never widens,
+    never touches integer quantities, and never bitcasts — the cast is a
+    rounding ``astype`` on the send side and a lossless widen on unpack.
+    Local copies (self-wrap fills, resident-neighbor shifts) are never
+    compressed: only bytes that actually cross the interconnect pay the
+    precision for the bandwidth."""
+    if wire_dtype is None:
+        return None
+    native = jnp.dtype(native)
+    wire = jnp.dtype(wire_dtype)
+    if not (jnp.issubdtype(native, jnp.floating)
+            and jnp.issubdtype(wire, jnp.floating)):
+        return None
+    if wire.itemsize >= native.itemsize:
+        return None
+    return wire
+
+
 def wrap_fill_batched(spec: GridSpec, a):
     """Periodic self-wrap halo fill of every *leading-dim* block: ``a`` is
     ``(..., pz, py, px)`` — e.g. the multi-tenant campaign's stacked

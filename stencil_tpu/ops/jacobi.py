@@ -317,6 +317,56 @@ def _compile_jacobi_auto(ex: HaloExchange, overlap: bool, iters,
     )
 
 
+def _compile_jacobi_remote(ex: HaloExchange, iters,
+                           temporal_k: Optional[int] = None,
+                           multistep_rows: Optional[int] = None):
+    """The REMOTE_DMA iteration: the exchange is NOT a ppermute program
+    that can inline into the shard_map'd step — on TPU it is the carrier-
+    kernel program (ops/remote_dma.py), off-TPU the host-orchestrated
+    emulation (parallel/remote_emu.py) — so the step is a host-chunked
+    serialized loop: one compiled exchange dispatch + one compiled
+    collective-free sweep per iteration. Values are bit-identical to the
+    AXIS_COMPOSED paths (the exchange fills the same cells; the sweep is
+    the same shifted-slice program reading the same exchanged state —
+    tests/test_remote_dma.py pins the full step). Fusing the carrier
+    into the substep kernel itself (the §5.8 endgame) is the hardware
+    session's follow-up, staged behind scripts/probe_remote_dma.py."""
+    spec = ex.spec
+    r = spec.radius
+    assert min(
+        r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)
+    ) >= 1, "jacobi needs face radius >= 1 on every side"
+    if temporal_k is not None or multistep_rows is not None:
+        from ..utils import logging as log
+
+        log.warn(
+            f"temporal_k={temporal_k} multistep_rows={multistep_rows} "
+            "ignored: the temporal multistep composes with in-step "
+            "ppermute exchanges; the REMOTE_DMA path runs per-step "
+            "exchange + sweep dispatches"
+        )
+    off = spec.compute_offset()
+    compute = Rect3(off, off + spec.base)
+
+    def sweep_body(curr, nxt, sel):
+        masks = (sel == 1, sel == 2)
+        return jacobi_sweep(curr, nxt, compute, masks)
+
+    sweep = jax.jit(jax.shard_map(
+        sweep_body, mesh=ex.mesh,
+        in_specs=(BLOCK_PSPEC,) * 3, out_specs=BLOCK_PSPEC,
+    ))
+
+    def loop(curr, nxt, sel):
+        for _ in range(iters or 1):
+            curr = ex(curr)        # kernel-initiated / emulated exchange
+            out = sweep(curr, nxt, sel)
+            curr, nxt = out, curr  # the reference double-buffer swap
+        return curr, nxt
+
+    return loop
+
+
 def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
                     standard_spheres: bool = True, interpret: bool = False,
                     temporal_k: Optional[int] = None,
@@ -326,6 +376,8 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
     if ex.method == Method.AUTO_SPMD:
         return _compile_jacobi_auto(ex, overlap, iters, temporal_k,
                                     multistep_rows)
+    if ex.method == Method.REMOTE_DMA:
+        return _compile_jacobi_remote(ex, iters, temporal_k, multistep_rows)
     assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
         "jacobi needs face radius >= 1 on every side"
     )

@@ -88,6 +88,17 @@ class Method(enum.Enum):
     AXIS_COMPOSED = "axis-composed"
     DIRECT26 = "direct26"
     AUTO_SPMD = "auto-spmd"
+    # Kernel-initiated halo exchange (the reference's tx_colocated /
+    # ColocatedDirectAccessSender peer-access analogue, §5.8): boundary
+    # slabs move as per-neighbor async remote copies issued from INSIDE
+    # the kernel (pltpu.make_async_remote_copy), bypassing the XLA
+    # collective path — a compiled REMOTE_DMA exchange contains ZERO
+    # collective-permutes. On TPU the carrier kernel lives in
+    # ops/remote_dma.py; off-TPU a semantics-exact emulation
+    # (parallel/remote_emu.py) performs the same per-neighbor copies as
+    # host-initiated device-to-device transfers — bit-identical to
+    # AXIS_COMPOSED, still zero collectives in every compiled program.
+    REMOTE_DMA = "remote-dma"
 
 
 def direction_bytes(spec: GridSpec, direction, itemsize: int) -> int:
@@ -128,7 +139,7 @@ class HaloExchange:
     """
 
     def __init__(self, spec: GridSpec, mesh: Mesh, method: Method = Method.AXIS_COMPOSED,
-                 batch_quantities: bool = True):
+                 batch_quantities: bool = True, wire_dtype=None):
         md = mesh_dim(mesh)
         # oversubscription (reference: dd.set_gpus({0,0}), stencil.hpp:154,
         # test_exchange.cu:52): more partition blocks than devices — the
@@ -159,11 +170,30 @@ class HaloExchange:
         self.mesh = mesh
         self.method = method
         self.batch_quantities = bool(batch_quantities)
+        # bf16-on-the-wire halo compression: wire-crossing packed
+        # carriers narrow to this dtype before the send and widen on
+        # unpack (ops/halo_fill.wire_narrow_dtype owns the policy: only
+        # floating carriers ever narrow; local copies stay lossless).
+        # Lossy by design — parity gates run with it off; bench_exchange
+        # --wire-ab measures the error it buys the bandwidth with.
+        if wire_dtype is not None:
+            wire_dtype = str(jnp.dtype(wire_dtype))
+            if method == Method.AUTO_SPMD:
+                from ..utils import logging as log
+
+                log.warn("wire_dtype is ignored for Method.AUTO_SPMD: the "
+                         "SPMD partitioner owns the collective schedule "
+                         "and packs no carriers")
+                wire_dtype = None
+        self.wire_dtype = wire_dtype
 
     @property
     def oversubscribed(self) -> bool:
         """More partition blocks than devices on at least one axis."""
         return self.resident != Dim3(1, 1, 1)
+
+    def _on_tpu(self) -> bool:
+        return all(d.platform == "tpu" for d in self.mesh.devices.flatten())
 
     @cached_property
     def plan(self):
@@ -173,6 +203,7 @@ class HaloExchange:
         return build_plan(
             self.spec, mesh_dim(self.mesh), self.method,
             batch_quantities=self.batch_quantities, resident=self.resident,
+            wire_dtype=self.wire_dtype,
         )
 
     # -- public API ----------------------------------------------------------
@@ -193,6 +224,14 @@ class HaloExchange:
                 "collectives are synthesized by the SPMD partitioner from "
                 "the global program (use __call__/make_loop/auto_fill, or a "
                 "manual method for shard_map composition)"
+            )
+        if self.method == Method.REMOTE_DMA:
+            raise RuntimeError(
+                "Method.REMOTE_DMA has no ppermute-style per-block body: "
+                "on TPU the carrier kernel owns the whole phase "
+                "(ops/remote_dma.py), and the CPU emulation is "
+                "host-orchestrated (use __call__/make_loop, or a manual "
+                "ppermute method for shard_map composition)"
             )
         if self.method == Method.DIRECT26:
             assert axes is None, "axis subsetting requires AXIS_COMPOSED"
@@ -243,10 +282,10 @@ class HaloExchange:
         self-wrap axes take a packed slab fill: one fused slice/update
         pair per phase for the group (the fp64 analogue of the fused
         fills; ROADMAP #5)."""
-        if self.method == Method.AUTO_SPMD:
+        if self.method in (Method.AUTO_SPMD, Method.REMOTE_DMA):
             raise RuntimeError(
-                "Method.AUTO_SPMD has no per-block exchange body (see "
-                "exchange_block); use __call__/make_loop/auto_fill instead"
+                f"Method.{self.method.name} has no per-block exchange body "
+                "(see exchange_block); use __call__/make_loop instead"
             )
         if not isinstance(state, dict):
             return jax.tree.map(self.exchange_block, state)
@@ -318,7 +357,25 @@ class HaloExchange:
         return cache[(axis, nq)]
 
     @cached_property
+    def _remote(self):
+        """The REMOTE_DMA transport: the Pallas carrier kernels on an
+        all-TPU mesh (ops/remote_dma.py — pltpu.make_async_remote_copy
+        from inside the kernel), the semantics-exact host-orchestrated
+        emulation everywhere else (parallel/remote_emu.py). Both are
+        callables over the state pytree; both compile ZERO collectives."""
+        assert self.method == Method.REMOTE_DMA
+        if self._on_tpu():
+            from ..ops.remote_dma import RemoteDmaExchange
+
+            return RemoteDmaExchange(self)
+        from .remote_emu import RemoteDmaEmulation
+
+        return RemoteDmaEmulation(self)
+
+    @cached_property
     def _compiled(self):
+        if self.method == Method.REMOTE_DMA:
+            return self._remote
         if self.method == Method.AUTO_SPMD:
             sh = self.sharding()
             return jax.jit(
@@ -344,10 +401,13 @@ class HaloExchange:
         program instead of retracing."""
         cache = self.__dict__.setdefault("_loops", {})
         if iters not in cache:
-            # build-phase accounting for all three strategies (the
+            # build-phase accounting for all strategies (the
             # flight-recorder bucket; jax.profiler sees the same range)
             with timer.timed("exchange.build"), \
                     timer.trace_range(f"exchange.{self.method.value}.build"):
+                if self.method == Method.REMOTE_DMA:
+                    cache[iters] = self._remote.make_loop(iters)
+                    return cache[iters]
                 if self.method == Method.AUTO_SPMD:
                     def many(state):
                         return lax.fori_loop(
@@ -385,6 +445,12 @@ class HaloExchange:
 
         with timer.timed("exchange.census"), \
                 timer.trace_range(f"exchange.{self.method.value}.census"):
+            if self.method == Method.REMOTE_DMA:
+                # no single jitted program exists: the transport censuses
+                # EVERY compiled piece of one exchange (pack/update jits
+                # of the emulation; the carrier-kernel program on TPU) —
+                # the 0-ppermute claim is over everything that compiles
+                return self._remote.collective_census(state)
             txt = self._compiled.lower(state).compile().as_text()
             return collective_census(txt)
 
@@ -519,6 +585,30 @@ class HaloExchange:
         Implemented as the batched body's Q=1 degeneration."""
         return self._axis_phase_resident_batched([block], phase)[0]
 
+    def _permute_wire(self, carrier, name, pairs):
+        """One wire-crossing ``ppermute`` of a packed carrier, paying the
+        optional bf16-on-the-wire compression: the carrier narrows to
+        ``wire_dtype`` on the send side and widens back after the permute
+        (rounding ``astype``, never a bitcast). ONLY data that actually
+        crosses the interconnect comes through here — self-wrap copies
+        and resident-neighbor shifts never do, so they stay lossless."""
+        from ..ops.halo_fill import wire_narrow_dtype
+
+        w = wire_narrow_dtype(carrier.dtype, self.wire_dtype)
+        if w is None:
+            return lax.ppermute(carrier, name, pairs)
+        native = carrier.dtype
+        # optimization_barrier on BOTH sides: XLA's convert-mover happily
+        # hoists a narrowing convert across a collective-permute (and
+        # fuses the pair back into a sender-side rounding), which keeps
+        # the rounding but puts full-width bytes back on the wire — the
+        # barriers pin narrow-before-send / widen-after-receive so the
+        # permute payload (what the census bytes count) really is the
+        # wire dtype
+        wired = lax.optimization_barrier(carrier.astype(w))
+        out = lax.optimization_barrier(lax.ppermute(wired, name, pairs))
+        return out.astype(native)
+
     # -- quantity-batched phases (packed carriers) ---------------------------
     def _axis_phase_batched(self, blocks, phase):
         """One composed axis phase for a same-dtype quantity group: every
@@ -555,7 +645,7 @@ class HaloExchange:
                 [_slice_in_dim(b, off + sz - rm, rm, adim) for b in blocks]
             )
             if n > 1:  # ONE permute for the whole group
-                carrier = lax.ppermute(carrier, name, fwd)
+                carrier = self._permute_wire(carrier, name, fwd)
             blocks = [
                 _update_in_dim(b, s, off - rm, adim)
                 for b, s in zip(blocks, unpack_slabs(carrier, nq))
@@ -565,7 +655,7 @@ class HaloExchange:
                 [_slice_in_dim(b, off, rp, adim) for b in blocks]
             )
             if n > 1:
-                carrier = lax.ppermute(carrier, name, bwd)
+                carrier = self._permute_wire(carrier, name, bwd)
             blocks = [
                 _update_in_dim(b, s, off + sz, adim)
                 for b, s in zip(blocks, unpack_slabs(carrier, nq))
@@ -608,7 +698,7 @@ class HaloExchange:
             ]
             incoming = [s[c - 1] for s in srcs]
             if m > 1:
-                carrier = lax.ppermute(pack_slabs(incoming), name, fwd)
+                carrier = self._permute_wire(pack_slabs(incoming), name, fwd)
                 incoming = unpack_slabs(carrier, nq)
             for q in range(nq):
                 for j in range(c):
@@ -620,7 +710,7 @@ class HaloExchange:
             srcs = [[take_j(b, j, off, rp) for j in range(c)] for b in blocks]
             incoming = [s[0] for s in srcs]
             if m > 1:
-                carrier = lax.ppermute(pack_slabs(incoming), name, bwd)
+                carrier = self._permute_wire(pack_slabs(incoming), name, bwd)
                 incoming = unpack_slabs(carrier, nq)
             for q in range(nq):
                 for j in range(c):
@@ -850,7 +940,7 @@ class HaloExchange:
         carrier of the quantity-batched path)."""
         d = Dim3.of(ph.direction)
         if not self.oversubscribed:
-            return lax.ppermute(slab, (AXIS_Z, AXIS_Y, AXIS_X), ph.pairs)
+            return self._permute_wire(slab, (AXIS_Z, AXIS_Y, AXIS_X), ph.pairs)
         md = mesh_dim(self.mesh)
         for name, bdim, comp, m, c in (
             (AXIS_Z, boff + 0, d.z, md.z, self.resident.z),
@@ -862,19 +952,21 @@ class HaloExchange:
             if c == 1:
                 if m > 1:
                     pairs = [(i, (i + comp) % m) for i in range(m)]
-                    slab = lax.ppermute(slab, name, pairs)
+                    slab = self._permute_wire(slab, name, pairs)
                 continue
             if comp == 1:
                 last = lax.slice_in_dim(slab, c - 1, c, axis=bdim)
                 if m > 1:
-                    last = lax.ppermute(last, name, [(i, (i + 1) % m) for i in range(m)])
+                    last = self._permute_wire(
+                        last, name, [(i, (i + 1) % m) for i in range(m)])
                 slab = jnp.concatenate(
                     [last, lax.slice_in_dim(slab, 0, c - 1, axis=bdim)], axis=bdim
                 )
             else:
                 first = lax.slice_in_dim(slab, 0, 1, axis=bdim)
                 if m > 1:
-                    first = lax.ppermute(first, name, [(i, (i - 1) % m) for i in range(m)])
+                    first = self._permute_wire(
+                        first, name, [(i, (i - 1) % m) for i in range(m)])
                 slab = jnp.concatenate(
                     [lax.slice_in_dim(slab, 1, c, axis=bdim), first], axis=bdim
                 )

@@ -30,6 +30,7 @@ from .ir import (
     ExchangePlan,
     PlanChoice,
     PlanConfig,
+    RemoteDmaPhaseIR,
     build_plan,
 )
 
@@ -39,5 +40,6 @@ __all__ = [
     "ExchangePlan",
     "PlanChoice",
     "PlanConfig",
+    "RemoteDmaPhaseIR",
     "build_plan",
 ]

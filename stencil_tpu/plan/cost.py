@@ -44,6 +44,7 @@ from .ir import (
     AXIS_COMPOSED,
     DIRECT26,
     METHODS,
+    REMOTE_DMA,
     PlanChoice,
     PlanConfig,
     build_plan,
@@ -64,6 +65,22 @@ DEFAULT_CALIBRATION: Dict[str, object] = {
     # relative compute factor per kernel variant (unknown -> 1.0: the
     # static model deliberately ties variants and lets the probes decide)
     "variant_factor": {},
+    # Method.REMOTE_DMA: kernel-initiated per-neighbor async copies
+    # bypass the XLA collective path entirely (0 ppermutes). Provenance:
+    # MODELED, pending the item-1 TPU recalibration session — no ICI
+    # measurement of this transport exists yet. dma_overhead_s is the
+    # modeled per-copy issue+sync cost on TPU (the whole point of the
+    # method: a fraction of a ppermute's ~0.66 ms dispatch);
+    # cpu_emulation_overhead_s prices the CPU lowering honestly — each
+    # emulated copy is a host-orchestrated device_put round-trip, so on
+    # a cpu-platform config REMOTE_DMA ranks BELOW the ppermute methods
+    # (the probes confirm; on tpu configs the model lets it compete).
+    "remote_dma": {
+        "dma_overhead_s": 8.0e-5,
+        "cpu_emulation_overhead_s": 4.0e-3,
+        "wire_bytes_per_s": 3.9e8,
+        "provenance": "modeled, pending item-1 TPU recalibration",
+    },
 }
 
 
@@ -77,6 +94,7 @@ class PlanCost:
     wire_bytes: int         # estimated interconnect bytes per exchange
     local_bytes: int        # estimated local slab bytes per exchange
     compute_overhead_s: float  # multistep redundant-compute price per step
+    dmas: int = 0           # kernel-initiated async copies (REMOTE_DMA only)
 
     def to_json(self) -> dict:
         return {
@@ -86,6 +104,7 @@ class PlanCost:
             "wire_bytes": self.wire_bytes,
             "local_bytes": self.local_bytes,
             "compute_overhead_s": self.compute_overhead_s,
+            "dmas": self.dmas,
         }
 
 
@@ -166,14 +185,29 @@ def score(config: PlanConfig, choice: PlanChoice,
     nq = config.num_quantities
     ngroups = config.dtype_group_count
     collectives = plan.collectives_per_exchange(nq, ngroups)
-    wire = plan.wire_bytes(itemsizes)
+    wire = plan.wire_bytes(itemsizes, floating=config.floating_flags())
     local = plan.local_bytes(itemsizes)
-    overhead = cal["permute_overhead_s"][choice.method]
-    exchange_s = (
-        collectives * overhead
-        + wire / cal["wire_bytes_per_s"]
-        + local / cal["local_bytes_per_s"]
-    )
+    dmas = plan.dmas_per_exchange(nq, ngroups)
+    if choice.method == REMOTE_DMA:
+        # kernel-initiated copies: no ppermute dispatch at all; the
+        # per-copy cost is platform-dependent (the CPU lowering is a
+        # host-orchestrated emulation and must never win a cpu ranking
+        # on the strength of a TPU-modeled constant)
+        rd = cal["remote_dma"]
+        per_dma = (rd["dma_overhead_s"] if config.platform == "tpu"
+                   else rd["cpu_emulation_overhead_s"])
+        exchange_s = (
+            dmas * per_dma
+            + wire / rd.get("wire_bytes_per_s", cal["wire_bytes_per_s"])
+            + local / cal["local_bytes_per_s"]
+        )
+    else:
+        overhead = cal["permute_overhead_s"][choice.method]
+        exchange_s = (
+            collectives * overhead
+            + wire / cal["wire_bytes_per_s"]
+            + local / cal["local_bytes_per_s"]
+        )
     k = choice.multistep_k
     compute_overhead_s = 0.0
     if k > 1:
@@ -193,7 +227,7 @@ def score(config: PlanConfig, choice: PlanChoice,
     return PlanCost(
         total_s=total, exchange_s=exchange_s, collectives=collectives,
         wire_bytes=wire, local_bytes=local,
-        compute_overhead_s=compute_overhead_s,
+        compute_overhead_s=compute_overhead_s, dmas=dmas,
     )
 
 

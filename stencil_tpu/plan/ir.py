@@ -36,7 +36,23 @@ from ..geometry import DIRECTIONS_26, Dim3, Radius
 AXIS_COMPOSED = "axis-composed"
 DIRECT26 = "direct26"
 AUTO_SPMD = "auto-spmd"
-METHODS = (AXIS_COMPOSED, DIRECT26, AUTO_SPMD)
+REMOTE_DMA = "remote-dma"
+METHODS = (AXIS_COMPOSED, DIRECT26, AUTO_SPMD, REMOTE_DMA)
+
+# Wire-compression itemsizes the IR can model without importing jax/numpy
+# (bfloat16 is not a numpy dtype name; everything else resolves lazily).
+_WIRE_ITEMSIZE = {"bfloat16": 2, "float16": 2, "float32": 4, "float64": 8}
+
+
+def wire_itemsize(wire_dtype: Optional[str]) -> Optional[int]:
+    """Bytes per cell a wire-compressed carrier pays (None = native)."""
+    if wire_dtype is None:
+        return None
+    if wire_dtype in _WIRE_ITEMSIZE:
+        return _WIRE_ITEMSIZE[wire_dtype]
+    import numpy as np
+
+    return np.dtype(wire_dtype).itemsize
 
 # (axis name, stacked-array data dim, block dim) in exchange-phase order —
 # the one authority for phase order; exchange.py consumes it via the plan.
@@ -115,6 +131,61 @@ class DirectPhaseIR:
 
 
 @dataclass(frozen=True)
+class RemoteDmaPhaseIR:
+    """One kernel-initiated axis phase of a ``REMOTE_DMA`` plan.
+
+    Same composed-phase slab geometry as :class:`AxisPhaseIR` (full
+    padded extents, x→y→z order, edges/corners composing across phases —
+    the wire model is shared), but the boundary slabs move as
+    per-neighbor async remote copies issued from inside the kernel
+    (``pltpu.make_async_remote_copy`` on TPU; host-initiated
+    device-to-device copies in the CPU emulation) instead of
+    ``lax.ppermute``: the XLA collective path is bypassed entirely, so
+    :meth:`collectives` is ZERO by construction — the census pin — and
+    :meth:`dmas` counts the async copies one carrier pays (≤ 2 per
+    phase: one toward each neighbor; Q-independent under the PR-5
+    per-dtype packed-carrier geometry). ``fwd``/``bwd`` are the neighbor
+    rings the DMAs target (the same pairs the composed permutes use)."""
+
+    axis: str               # 'x' | 'y' | 'z' (mesh axis name)
+    adim: int               # stacked-array data dim
+    bdim: int               # stacked-array block dim
+    ring: int               # DMA participants along this axis
+    resident: int           # blocks resident per device along this axis
+    rm: int                 # low-side radius
+    rp: int                 # high-side radius
+    offset: int             # allocation-local compute origin
+    sizes: Tuple[int, ...]  # per-block logical sizes (full table)
+    fwd: Tuple[Tuple[int, int], ...]   # +axis neighbor ring (DMA targets)
+    bwd: Tuple[Tuple[int, int], ...]
+    wire_cells: int         # cells DMA'd per exchange per quantity (all devices)
+    local_cells: int        # cells moved locally (self-wrap / resident shifts)
+
+    @property
+    def blocks(self) -> int:
+        return self.ring * self.resident
+
+    @property
+    def uniform(self) -> bool:
+        return len(set(self.sizes)) == 1
+
+    @property
+    def active(self) -> bool:
+        return self.rm > 0 or self.rp > 0
+
+    def collectives(self) -> int:
+        """Always 0: the DMAs live inside the kernel custom-call, not on
+        the XLA collective path — nothing for a ppermute census to see."""
+        return 0
+
+    def dmas(self) -> int:
+        """Async remote copies one carrier pays for this phase."""
+        if self.ring <= 1 or not self.active:
+            return 0
+        return (1 if self.rm > 0 else 0) + (1 if self.rp > 0 else 0)
+
+
+@dataclass(frozen=True)
 class ExchangePlan:
     """The full declarative exchange program for one (spec, mesh, method).
 
@@ -135,7 +206,13 @@ class ExchangePlan:
     resident: Tuple[int, int, int]
     axis_phases: Tuple[AxisPhaseIR, ...]  # always built (composed geometry)
     direct_phases: Tuple[DirectPhaseIR, ...] = ()
+    remote_phases: Tuple[RemoteDmaPhaseIR, ...] = ()
     synthesized: bool = False
+    # bf16-on-the-wire halo compression: wire-crossing carriers narrow to
+    # this dtype before the send and widen on unpack (None = native).
+    # Applies to the packed-carrier methods (composed/direct26/remote-dma);
+    # local copies and self-wrap fills always stay native/lossless.
+    wire_dtype: Optional[str] = None
 
     @property
     def batch_quantities(self) -> bool:
@@ -143,7 +220,11 @@ class ExchangePlan:
 
     @property
     def phases(self) -> Tuple:
-        return self.direct_phases if self.method == DIRECT26 else self.axis_phases
+        if self.method == DIRECT26:
+            return self.direct_phases
+        if self.method == REMOTE_DMA:
+            return self.remote_phases
+        return self.axis_phases
 
     def collectives_per_exchange(self, quantities: int = 1,
                                  dtype_groups: int = 1) -> int:
@@ -157,13 +238,38 @@ class ExchangePlan:
             carriers = quantities  # the partitioner packs nothing today
         return sum(p.collectives() for p in self.phases) * carriers
 
-    def wire_bytes(self, itemsizes: Sequence[int]) -> int:
+    def dmas_per_exchange(self, quantities: int = 1,
+                          dtype_groups: int = 1) -> int:
+        """Predicted kernel-initiated async remote copies of one
+        REMOTE_DMA exchange (0 for the ppermute methods): ≤ 2 per axis
+        phase per carrier, Q-independent under per-dtype packing — the
+        DMA analogue of :meth:`collectives_per_exchange`."""
+        if self.method != REMOTE_DMA:
+            return 0
+        carriers = dtype_groups if self.batch_quantities else quantities
+        return sum(p.dmas() for p in self.remote_phases) * carriers
+
+    def wire_bytes(self, itemsizes: Sequence[int],
+                   floating: Optional[Sequence[bool]] = None) -> int:
         """Estimated bytes on the interconnect per exchange (all
         quantities). Exact on one-block-per-device meshes; under
         oversubscription DIRECT26 carriers are counted whole although
         resident-internal shifts stay local (a deliberate overestimate —
-        the census remains the compile-time truth)."""
-        per_cell = sum(itemsizes)
+        the census remains the compile-time truth). With ``wire_dtype``
+        set, wire-crossing cells pay the narrowed itemsize (the bf16
+        compression halves fp32 on-wire bytes; local bytes stay native).
+        ``floating`` flags which quantities can narrow at all — the
+        lowering (halo_fill.wire_narrow_dtype) never compresses integer
+        carriers, so their wire bytes must stay native; omitted, every
+        quantity is assumed floating (this framework's default)."""
+        w = wire_itemsize(self.wire_dtype) if not self.synthesized else None
+        if w is None:
+            per_cell = sum(itemsizes)
+        else:
+            fl = ([True] * len(itemsizes) if floating is None
+                  else list(floating))
+            per_cell = sum(min(i, w) if f else i
+                           for i, f in zip(itemsizes, fl))
         return sum(p.wire_cells for p in self.phases) * per_cell
 
     def local_bytes(self, itemsizes: Sequence[int]) -> int:
@@ -179,10 +285,17 @@ class ExchangePlan:
             f"partition={self.partition} mesh={self.mesh_dim} "
             f"resident={self.resident}"
             + (" (schedule synthesized by the SPMD partitioner)"
-               if self.synthesized else ""),
+               if self.synthesized else "")
+            + (f" wire_dtype={self.wire_dtype}" if self.wire_dtype else ""),
         ]
         for p in self.phases:
-            if isinstance(p, AxisPhaseIR):
+            if isinstance(p, RemoteDmaPhaseIR):
+                lines.append(
+                    f"  axis {p.axis}: ring={p.ring} resident={p.resident} "
+                    f"rm={p.rm} rp={p.rp} permutes=0 dmas={p.dmas()} "
+                    f"wire_cells={p.wire_cells} local_cells={p.local_cells}"
+                )
+            elif isinstance(p, AxisPhaseIR):
                 lines.append(
                     f"  axis {p.axis}: ring={p.ring} resident={p.resident} "
                     f"rm={p.rm} rp={p.rp} permutes={p.collectives()} "
@@ -197,6 +310,21 @@ class ExchangePlan:
             f"  total permutes/exchange (1 group): "
             f"{self.collectives_per_exchange()}"
         )
+        if self.method == REMOTE_DMA:
+            lines.append(
+                f"  total async remote copies/exchange (1 group): "
+                f"{self.dmas_per_exchange()} (kernel-initiated — the "
+                "census sees 0 ppermutes)"
+            )
+        if self.wire_dtype and not self.synthesized:
+            import dataclasses
+
+            native = dataclasses.replace(self, wire_dtype=None)
+            lines.append(
+                f"  wire bytes (1 fp32 quantity): {self.wire_bytes([4])} "
+                f"({self.wire_dtype} on the wire; {native.wire_bytes([4])} "
+                "native)"
+            )
         return "\n".join(lines)
 
 
@@ -340,14 +468,35 @@ def _direct_phases(spec, mesh_dim: Dim3,
     return tuple(phases)
 
 
+def _remote_phases(axis_phases: Tuple[AxisPhaseIR, ...]
+                   ) -> Tuple[RemoteDmaPhaseIR, ...]:
+    """REMOTE_DMA phases from the composed geometry: identical slab
+    extents, sizes, and neighbor rings — only the transport differs
+    (kernel-initiated DMAs instead of ppermutes), so the wire model is
+    literally the composed one and parity vs AXIS_COMPOSED is a
+    geometry-free claim about data movement."""
+    return tuple(
+        RemoteDmaPhaseIR(
+            axis=p.axis, adim=p.adim, bdim=p.bdim, ring=p.ring,
+            resident=p.resident, rm=p.rm, rp=p.rp, offset=p.offset,
+            sizes=p.sizes, fwd=p.fwd, bwd=p.bwd,
+            wire_cells=p.wire_cells, local_cells=p.local_cells,
+        )
+        for p in axis_phases
+    )
+
+
 def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
-               resident: Optional[Dim3] = None) -> ExchangePlan:
+               resident: Optional[Dim3] = None,
+               wire_dtype: Optional[str] = None) -> ExchangePlan:
     """Build the ExchangePlan of one (GridSpec, mesh shape, method).
 
     Pure geometry — no jax, no devices. ``method`` may be the enum from
     ``parallel.exchange`` or its value string. ``mesh_dim`` is the device
     grid (x, y, z); ``resident`` (blocks stacked per device) defaults to
-    ``spec.dim / mesh_dim`` and must divide it exactly.
+    ``spec.dim / mesh_dim`` and must divide it exactly. ``wire_dtype``
+    narrows wire-crossing carriers in the byte model (the bf16-on-the-wire
+    halo compression knob).
     """
     mval = getattr(method, "value", method)
     if mval not in METHODS:
@@ -365,6 +514,7 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
     direct_phases = (
         _direct_phases(spec, md, resident) if mval == DIRECT26 else ()
     )
+    remote_phases = _remote_phases(axis_phases) if mval == REMOTE_DMA else ()
     return ExchangePlan(
         method=mval,
         pack_groups="dtype" if batch_quantities else "quantity",
@@ -373,7 +523,9 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
         resident=(resident.x, resident.y, resident.z),
         axis_phases=axis_phases,
         direct_phases=direct_phases,
+        remote_phases=remote_phases,
         synthesized=synthesized,
+        wire_dtype=wire_dtype,
     )
 
 
@@ -441,6 +593,17 @@ class PlanConfig:
         out = []
         for dt, n in self.quantities:
             out.extend([np.dtype(dt).itemsize] * n)
+        return tuple(out)
+
+    def floating_flags(self) -> Tuple[bool, ...]:
+        """Per-quantity floatness, aligned with :meth:`itemsizes` — the
+        wire-compression eligibility mask for ``ExchangePlan.wire_bytes``
+        (integer carriers never narrow)."""
+        import numpy as np
+
+        out = []
+        for dt, n in self.quantities:
+            out.extend([np.issubdtype(np.dtype(dt), np.floating)] * n)
         return tuple(out)
 
     def radius_obj(self) -> Radius:

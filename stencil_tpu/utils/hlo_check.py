@@ -220,6 +220,52 @@ def collective_census(hlo_text: str) -> Dict[str, Tuple[int, int]]:
     return out
 
 
+_STABLEHLO_OP_RE = re.compile(
+    r'"stablehlo\.(collective_permute|all_gather|all_reduce|all_to_all|'
+    r'reduce_scatter|collective_broadcast)"'
+)
+_STABLEHLO_RESULT_RE = re.compile(r"->\s*tensor<([0-9x]+)x([a-zA-Z0-9]+)>")
+_STABLEHLO_PAIRS_RE = re.compile(r"source_target_pairs\s*=[^:]*:\s*tensor<(\d+)x2xi64>")
+_STABLEHLO_DTYPE_BYTES = {
+    "i1": 1, "i8": 1, "ui8": 1, "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "i32": 4, "ui32": 4, "f32": 4, "i64": 8, "ui64": 8, "f64": 8,
+}
+
+
+def stablehlo_wire_census(mlir_text: str) -> Dict[str, Tuple[int, int]]:
+    """``{op kind: (count, bytes)}`` over a LOWERED (pre-backend-
+    optimization) StableHLO module — what the program *asks* the wire to
+    carry, counted like :func:`collective_census` (per-shard payload ×
+    source_target pairs for permutes).
+
+    Why a second census exists: backend optimization passes may rewrite
+    payload dtypes — the CPU backend's float-normalization widens a bf16
+    ``collective_permute`` back to f32 (bf16 is not a native CPU type),
+    so a compiled-HLO census on the 8-device CPU mesh cannot see the
+    bf16-on-the-wire compression that a TPU (native bf16) actually
+    ships. This census reads the module BEFORE those passes: the
+    wire-dtype the exchange requested, exact for the hand-written
+    permute methods whose collectives exist pre-partitioning."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for ln in mlir_text.splitlines():
+        m = _STABLEHLO_OP_RE.search(ln)
+        if not m:
+            continue
+        kind = m.group(1).replace("_", "-")
+        rm_ = _STABLEHLO_RESULT_RE.search(ln)
+        payload = 0
+        if rm_:
+            dims, dtype = rm_.group(1), rm_.group(2)
+            payload = _STABLEHLO_DTYPE_BYTES.get(dtype, 0)
+            for d in dims.split("x"):
+                payload *= int(d)
+        pm = _STABLEHLO_PAIRS_RE.search(ln)
+        fanout = int(pm.group(1)) if pm else 1
+        count, nbytes = out.get(kind, (0, 0))
+        out[kind] = (count + 1, nbytes + payload * max(1, fanout))
+    return out
+
+
 def census_per_quantity(census: Dict[str, Tuple[int, int]],
                         quantities: int) -> Dict[str, Tuple[int, int]]:
     """Attribute a quantity-batched census back to logical per-quantity

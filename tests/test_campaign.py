@@ -93,9 +93,9 @@ def test_plan_slots_fifo_bucketed():
     # bucket of the queue head first; same-bucket jobs pulled forward in
     # FIFO order; the fourth 12^3 job overflows into a later slot
     assert got == [
-        (((12, 12, 12), "float32"), ["a0", "a1", "a2"]),
-        (((10, 10, 10), "float32"), ["b0", "b1"]),
-        (((12, 12, 12), "float32"), ["a3"]),
+        (((12, 12, 12), "float32", "jacobi"), ["a0", "a1", "a2"]),
+        (((10, 10, 10), "float32", "jacobi"), ["b0", "b1"]),
+        (((12, 12, 12), "float32", "jacobi"), ["a3"]),
     ]
     # pure + deterministic
     assert got == plan_slots(jobs, 3)
@@ -374,3 +374,124 @@ def test_report_p99_column_and_mode_split():
     assert 0.01 < p99 < 0.1
     # default stays the historical table (no new column)
     assert "p99_s" not in tables(agg)
+
+
+# -- astaroth campaigns through the driver (ISSUE-10 satellite) ----------------
+
+
+def test_workload_joins_the_bucket():
+    jobs = [
+        TenantJob("j0", (8, 8, 8), 2, "float64", workload="jacobi"),
+        TenantJob("a0", (8, 8, 8), 2, "float64", workload="astaroth"),
+        TenantJob("j1", (8, 8, 8), 2, "float64", workload="jacobi"),
+        TenantJob("a1", (8, 8, 8), 2, "float64", workload="astaroth"),
+    ]
+    # jacobi and astaroth tenants never share a slot: their compiled
+    # programs (and quantity sets) differ even at identical (size, dtype)
+    slots = plan_slots(jobs, 4)
+    assert [tids for _b, tids in slots] == [["j0", "j1"], ["a0", "a1"]]
+    assert slots[0][0][2] == "jacobi" and slots[1][0][2] == "astaroth"
+
+
+def test_unknown_workload_rejected(tmp_path):
+    with pytest.raises(ValueError, match="workload"):
+        CampaignDriver(
+            [TenantJob("t0", (8, 8, 8), 1, workload="lbm")], 1,
+            str(tmp_path / "c"))
+
+
+def test_astaroth_sequential_baseline_refused():
+    with pytest.raises(NotImplementedError, match="jacobi"):
+        run_sequential(
+            [TenantJob("a0", (8, 8, 8), 1, "float64",
+                       workload="astaroth")])
+
+
+def test_astaroth_campaign_driver_parity_b2(tmp_path):
+    """The ISSUE-10 satellite pin: astaroth tenants served by the
+    campaign driver at B=2 finish bit-identical to the SAME batched-step
+    program driven directly (the driver adds queueing/packing/guarding/
+    retire bookkeeping, never numerics), and every per-tenant snapshot
+    carries all 8 fields."""
+    import jax.numpy as jnp
+
+    from stencil_tpu.astaroth.integrate import FIELDS
+    from stencil_tpu.campaign import WORKLOADS, astaroth_init_state
+    from stencil_tpu.domain.grid import GridSpec
+    from stencil_tpu.geometry import Dim3, Radius
+
+    n, B, steps, chunk = 8, 2, 2, 2
+    jobs = [TenantJob(f"t{i}", (n, n, n), steps, "float64", seed=i,
+                      workload="astaroth") for i in range(B)]
+    devs = jax.devices()[:2]
+    drv = CampaignDriver(jobs, B, str(tmp_path / "c"), devices=devs,
+                         chunk=chunk)
+    res = drv.run()["results"]
+    assert sorted(res) == ["t0", "t1"]
+    assert all(r.outcome == "done" for r in res.values())
+
+    # reference: the workload's own compiled program (same sharding, same
+    # chunk plan), driven by hand from the same seeded init
+    wl = WORKLOADS["astaroth"]
+    spec = GridSpec(Dim3(n, n, n), Dim3(1, 1, 1), Radius.constant(3),
+                    aligned=False)
+    p, off = spec.padded(), spec.compute_offset()
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(devs), ("b",))
+    sh = NamedSharding(mesh, P("b"))
+    shr = NamedSharding(mesh, P())
+
+    def pack(key):
+        a = np.zeros((B, p.z, p.y, p.x), np.float64)
+        for b, job in enumerate(jobs):
+            a[b, off.z:off.z + n, off.y:off.y + n, off.x:off.x + n] = (
+                astaroth_init_state(job)[key])
+        return jax.device_put(jnp.asarray(a), sh)
+
+    curr = {k: pack(k) for k in FIELDS}
+    scratch = {k: jax.device_put(jnp.zeros((B, p.z, p.y, p.x)), sh)
+               for k in FIELDS}
+    loop = wl.build_loop(spec, chunk, sh, shr, batch=B, use_pallas=False)
+    done = 0
+    while done < steps:
+        curr = wl.step(loop, curr, scratch, None)
+        done += chunk
+    for b, job in enumerate(jobs):
+        fins = res[job.tid].finals
+        assert sorted(fins) == sorted(FIELDS)
+        for kf in FIELDS:
+            ref = np.asarray(jax.device_get(curr[kf]))[
+                b, off.z:off.z + n, off.y:off.y + n, off.x:off.x + n]
+            np.testing.assert_array_equal(fins[kf], ref,
+                                          err_msg=f"{job.tid}/{kf}")
+    # the tenant snapshot dirs are revivable 8-field snapshots
+    from stencil_tpu.ckpt import find_resume
+
+    found = find_resume(os.path.join(str(tmp_path / "c"), "tenants", "t0"))
+    assert found is not None
+    _snap, manifest = found
+    assert sorted(q["name"] for q in manifest["quantities"]) == sorted(FIELDS)
+
+
+def test_astaroth_campaign_b2_matches_b1_lanes(tmp_path):
+    """Batching independence at the driver level: each astaroth tenant
+    served in a B=2 slot equals the same tenant served alone in a B=1
+    slot (same tolerance discipline as the batched-step parity suite)."""
+    n, steps = 8, 2
+    jobs = [TenantJob(f"t{i}", (n, n, n), steps, "float64", seed=i,
+                      workload="astaroth") for i in range(2)]
+    devs = jax.devices()[:1]
+    r2 = CampaignDriver(jobs, 2, str(tmp_path / "b2"), devices=devs,
+                        chunk=2).run()["results"]
+    r1 = {}
+    for job in jobs:
+        r1.update(CampaignDriver([job], 1, str(tmp_path / f"b1-{job.tid}"),
+                                 devices=devs, chunk=2).run()["results"])
+    from stencil_tpu.astaroth.integrate import FIELDS
+
+    for tid in ("t0", "t1"):
+        for kf in FIELDS:
+            np.testing.assert_allclose(
+                r2[tid].finals[kf], r1[tid].finals[kf],
+                rtol=1e-10, atol=1e-12, err_msg=f"{tid}/{kf}")
