@@ -55,6 +55,101 @@ def start_metrics(args, app: str) -> "telemetry.Recorder":
     )
 
 
+def add_live_flags(p) -> None:
+    """The live-observability flags (obs/live.py + obs/status.py) the
+    guarded apps share: an in-run anomaly sentinel over the chunk-cycle
+    step latency, and an atomic run-status snapshot file."""
+    p.add_argument(
+        "--status-file", default=os.environ.get("STENCIL_STATUS_FILE", ""),
+        help="rewrite an atomic run-status snapshot here every chunk "
+             "(step, throughput, health counts, anomalies; read it with "
+             "`report --status FILE [--follow]`)",
+    )
+    p.add_argument(
+        "--live-sentinel", action="store_true",
+        help="in-run anomaly detection: judge each chunk's per-step "
+             "latency against a streaming trimean±MAD band (obs/live.py); "
+             "excursions emit anomaly.detected / replan.requested records "
+             "mid-run and show in the status snapshot",
+    )
+    p.add_argument(
+        "--live-config", default="",
+        help="sentinel knobs as JSON (inline '{...}' or a file path): "
+             "{\"*\": {window, min_history, mad_k, rel_tol, abs_tol, "
+             "direction, clear_after}, \"<key>\": {...}} — the perf_tool "
+             "--leg-config shape",
+    )
+
+
+def load_live_config(value: str) -> dict:
+    """Parse --live-config: an inline JSON object or a JSON file path.
+    Raises OSError/ValueError — the apps pre-validate at parse time and
+    map both to a clean argparse error, never a traceback."""
+    import json
+
+    if not value:
+        return {}
+    if value.lstrip()[:1] in ("{", "["):  # inline JSON, not a path
+        text = value
+    else:
+        with open(value) as f:
+            text = f.read()
+    cfg = json.loads(text)  # JSONDecodeError is a ValueError
+    if not isinstance(cfg, dict):
+        raise ValueError("--live-config must be a JSON object")
+    from ..obs.live import validate_config
+
+    errs = validate_config(cfg)
+    if errs:
+        raise ValueError("; ".join(errs))
+    return cfg
+
+
+def canonicalize_live_config(args) -> dict:
+    """Parse-time validation of ``--live-config`` that ALSO rewrites the
+    flag to canonical inline JSON, so ``make_live`` never re-reads a
+    file (the validated content is what runs — no window for the file
+    to change or vanish between argparse and backend init). Returns the
+    parsed config; raises OSError/ValueError for the app's ``p.error``."""
+    import json
+
+    cfg = load_live_config(getattr(args, "live_config", ""))
+    args.live_config = json.dumps(cfg) if cfg else ""
+    return cfg
+
+
+def make_live(args, rec: "telemetry.Recorder", app: str):
+    """Build the (sentinel, status writer) pair from parsed flags —
+    (None, None) when neither live flag is set."""
+    sentinel = status = None
+    if getattr(args, "live_sentinel", False):
+        from ..obs.live import LiveSentinel
+
+        sentinel = LiveSentinel(
+            load_live_config(getattr(args, "live_config", "")), rec=rec)
+    if getattr(args, "status_file", ""):
+        from ..obs.status import StatusWriter
+
+        status = StatusWriter(args.status_file, app=app, run=rec.run_id)
+    return sentinel, status
+
+
+def finish_live(rec: "telemetry.Recorder", sentinel, status,
+                outcome: Optional[str] = None, gauge: bool = True) -> None:
+    """The live epilogue: the run's anomaly count lands as a gauge (so
+    metrics-JSONL ingest puts in-run instability in the LEDGER, where
+    the cross-run sentinel sees it), and the final status snapshot gets
+    its outcome. ``gauge=False`` for callers whose engine already
+    emitted the count (the campaign driver does)."""
+    if gauge and sentinel is not None and rec.enabled:
+        rec.gauge("live.anomaly_count", float(sentinel.detected_total),
+                  phase="live")
+    if status is not None:
+        status.update(outcome=outcome,
+                      anomalies=(sentinel.summary()
+                                 if sentinel is not None else None))
+
+
 def finish_metrics(rec: "telemetry.Recorder") -> None:
     """The apps' shared exit epilogue: snapshot the global timer buckets
     as gauges and close the sink (no-op on a disabled recorder)."""

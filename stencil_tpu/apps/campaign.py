@@ -55,18 +55,51 @@ def _round6(value: float):
     return round(value, 6) if math.isfinite(value) else None
 
 
+def parse_deadlines(spec: str) -> dict:
+    """``--deadline-ms`` grammar: a bare number applies to every tenant
+    (``"50"``), comma-separated ``tid=ms`` pairs pin individual tenants
+    (``"t1=0.5,t3=100"``); ``*=ms`` mixes a default with overrides.
+    Raises ValueError on anything else — a mistyped SLO must never run
+    the campaign silently un-judged (the fault-spec discipline)."""
+    out: dict = {}
+    if not spec:
+        return out
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" in item:
+            tid, ms = item.split("=", 1)
+            out[tid.strip()] = float(ms)
+        else:
+            out["*"] = float(item)
+    for tid, ms in out.items():
+        if not math.isfinite(ms) or ms <= 0:
+            # float('nan') parses fine but p99 > nan is always False —
+            # the tenant would run with its SLO silently un-judged
+            raise ValueError(f"deadline for {tid!r} must be a positive "
+                             f"finite number of ms, got {ms!r}")
+    return out
+
+
 def build_jobs(args) -> list:
     from ..campaign import TenantJob
 
+    # main() stashes the validated dict; a programmatic caller without
+    # it falls back to parsing the raw flag
+    deadlines = getattr(args, "_deadlines", None)
+    if deadlines is None:
+        deadlines = parse_deadlines(args.deadline_ms)
     return [
         TenantJob(f"t{i}", (args.size, args.size, args.size), args.steps,
                   args.dtype, seed=args.init_seed + i,
-                  workload=args.workload)
+                  workload=args.workload,
+                  deadline_ms=deadlines.get(f"t{i}", deadlines.get("*")))
         for i in range(args.tenants)
     ]
 
 
-def run_modes(args, campaign_dir: str) -> dict:
+def run_modes(args, campaign_dir: str, sentinel=None, status=None) -> dict:
     from ..campaign import CampaignDriver, CompileCache, run_sequential
 
     devices = jax.devices()[: args.cpu] if args.cpu else jax.devices()
@@ -110,6 +143,7 @@ def run_modes(args, campaign_dir: str) -> dict:
             rollback_backoff=args.rollback_backoff,
             inject=args.inject or None, inject_seed=args.inject_seed,
             resume=args.resume, cache=cache, use_pallas=args.use_pallas,
+            sentinel=sentinel, status=status,
         )
         bat = drv.run()
         out["batched_mcells_per_s"] = round(
@@ -118,6 +152,8 @@ def run_modes(args, campaign_dir: str) -> dict:
         out["batched_p99_step_s"] = _round6(bat["p99_step_s"])
         out["slots"] = bat["slots"]
         out["evicted"] = bat["evicted"]
+        out["slo_violations"] = bat["slo_violations"]
+        out["anomalies"] = bat["anomalies"]
         out["cache"] = bat["cache"]
         _finite_gauge(rec, "campaign.batched_mcells_per_s",
                       bat["aggregate_mcells_per_s"], phase="step")
@@ -208,11 +244,51 @@ def main(argv: Optional[list] = None) -> int:
                    help="tenant i's initial field is seeded init-seed + i")
     p.add_argument("--use-pallas", action="store_true",
                    help="batched Pallas fast path (TPU; aligned layout)")
+    p.add_argument("--deadline-ms", default="",
+                   help="per-step latency SLO: a bare number applies to "
+                        "all tenants, 'tid=ms' pairs pin individuals "
+                        "('t1=0.5,t3=100'); a tenant whose ONLINE p99 "
+                        "exceeds its deadline emits one slo.violation "
+                        "record and shows as violated in the status lanes")
     p.add_argument("--cpu", type=int, default=0,
                    help="force N virtual CPU devices")
-    from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
+    from ._bench_common import (add_live_flags, add_metrics_flags,
+                                finish_live, finish_metrics, make_live,
+                                start_metrics)
     add_metrics_flags(p)
+    add_live_flags(p)
     args = p.parse_args(argv)
+    try:
+        deadlines = parse_deadlines(args.deadline_ms)
+    except ValueError as e:
+        p.error(f"bad --deadline-ms: {e}")
+    args._deadlines = deadlines  # parsed once; build_jobs reuses it
+    known = {f"t{i}" for i in range(args.tenants)} | {"*"}
+    unknown = sorted(set(deadlines) - known)
+    if unknown:
+        # a mistyped tenant id must not run the campaign un-judged
+        p.error(f"--deadline-ms names unknown tenant(s) {unknown} "
+                f"(tenants are t0..t{args.tenants - 1})")
+    if args.mode == "sequential":
+        # the live layer rides the guarded batched driver; accepting the
+        # flags here would silently observe nothing
+        if args.live_sentinel:
+            p.error("--live-sentinel rides the batched driver; --mode "
+                    "sequential runs outside it (use batched or ab)")
+        if args.status_file:
+            # may come from the globally-exported STENCIL_STATUS_FILE
+            # env var rather than the command line — warn + ignore
+            # instead of breaking every sequential invocation in an
+            # environment that sets it for the other apps
+            log.warn("campaign: --status-file/STENCIL_STATUS_FILE is "
+                     "ignored in --mode sequential (status snapshots "
+                     "ride the guarded batched driver)")
+            args.status_file = ""
+    from ._bench_common import canonicalize_live_config
+    try:
+        canonicalize_live_config(args)
+    except (OSError, ValueError) as e:
+        p.error(f"bad --live-config: {e}")
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -227,10 +303,13 @@ def main(argv: Optional[list] = None) -> int:
                 "batched Pallas astaroth substep is a hardware-session "
                 "follow-up (drop --use-pallas)")
     rec = start_metrics(args, "campaign")
+    sentinel, status = make_live(args, rec, "campaign")
 
     campaign_dir = args.campaign_dir or tempfile.mkdtemp(prefix="campaign-")
-    out = run_modes(args, campaign_dir)
+    out = run_modes(args, campaign_dir, sentinel=sentinel, status=status)
     print(json.dumps(out, default=str))
+    # gauge=False: the driver's run() already recorded live.anomaly_count
+    finish_live(rec, sentinel, status, outcome="done", gauge=False)
     finish_metrics(rec)
     if out.get("parity") == "MISMATCH":
         return 1

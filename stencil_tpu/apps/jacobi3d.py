@@ -79,6 +79,8 @@ def run(
     rollback_backoff: float = 0.25,
     inject: Optional[str] = None,
     wire_dtype: Optional[str] = None,
+    sentinel=None,
+    status=None,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -304,6 +306,7 @@ def run(
         save_fn=save_fn, ckpt_every=ckpt_every, restore_fn=restore_fn,
         quarantine_fn=quarantine_fn, flush_fn=flush_fn, on_chunk=on_chunk,
         spec=dd.spec, ckpt_dir=ckpt_dir, app="jacobi3d",
+        sentinel=sentinel, status=status,
     )
     # whole-loop wall clock, INCLUDING what the per-chunk spans exclude
     # (health checks, checkpoint saves, injected faults, backoff and
@@ -495,15 +498,23 @@ def main(argv: Optional[list] = None) -> int:
                         "(default: automatic — full planes while they reach "
                         "the depth cap, row-tiled staging beyond; the "
                         "probing knob for the 768^3 depth regime)")
-    from ._bench_common import add_metrics_flags, start_metrics
+    from ._bench_common import (add_live_flags, add_metrics_flags,
+                                canonicalize_live_config, finish_live,
+                                make_live, start_metrics)
     add_metrics_flags(p, dma=True)
+    add_live_flags(p)
     args = p.parse_args(argv)
+    try:
+        canonicalize_live_config(args)
+    except (OSError, ValueError) as e:
+        p.error(f"bad --live-config: {e}")
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         # must happen before backend init to actually create N devices
         jax.config.update("jax_num_cpu_devices", args.cpu)
     rec = start_metrics(args, "jacobi3d")
+    sentinel, status = make_live(args, rec, "jacobi3d")
 
     paraview_every = args.paraview_every
     if args.checkpoint_period is not None:
@@ -544,15 +555,19 @@ def main(argv: Optional[list] = None) -> int:
             rollback_backoff=args.rollback_backoff,
             inject=args.inject or None,
             wire_dtype=args.wire_dtype or None,
+            sentinel=sentinel,
+            status=status,
         )
     except RecoveryExhausted as e:
         # the loud-degrade contract: evidence bundle on disk, the distinct
         # rc for the watchdog/bench ladder, metrics flushed for archiving
         log.error(f"jacobi3d: {e}")
+        finish_live(rec, sentinel, status, outcome="fault")
         if rec.enabled:
             rec.record_timer_buckets()
             rec.close()
         return FAULT_RC
+    finish_live(rec, sentinel, status, outcome="done")
     print(csv_row(r))
     log.info(f"mcells/s = {r['mcells_per_s']:.1f} ({r['mcells_per_s_per_dev']:.1f}/device)")
     log.info(timer.report())

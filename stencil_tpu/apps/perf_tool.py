@@ -35,28 +35,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..obs import ledger
 from .report import _rows_to_table
-
-# Units/suffixes where smaller is better (times, rc codes); everything
-# else (throughputs, ratios, ok flags) defaults to higher-is-better.
-_LOWER_UNITS = ("s", "ms", "us", "rc")
-_LOWER_SUFFIXES = ("_s", "_ms", "_seconds", "_iter_ms", ".rc")
-
-
-def base_metric(name: str) -> str:
-    """Strip the report-style ``[method,batched]`` tag suffix so per-leg
-    threshold config matches the logical leg name."""
-    return name.split("[", 1)[0]
-
-
-def default_direction(metric: str, unit: Optional[str]) -> str:
-    m = base_metric(metric)
-    # throughput names ("..._gb_per_s", "mcells_per_s") end in "_s" too —
-    # the rate test must run before the seconds-suffix test
-    if m.endswith("_per_s") or m.endswith("_per_dev"):
-        return "higher"
-    if (unit or "") in _LOWER_UNITS or m.endswith(_LOWER_SUFFIXES):
-        return "lower"
-    return "higher"
+# the band/direction semantics are shared with the IN-run sentinel
+# (obs/live.py is the one authority; this module applies them to the
+# cross-run ledger, live.py to streaming chunk latencies)
+from ..obs.live import base_metric, default_direction  # noqa: F401
 
 
 _ROUND_LABEL_RE = re.compile(r"^r(\d+)$")
@@ -155,6 +137,49 @@ def trend_tables(entries: Sequence[dict],
             ["label", "value", "unit", "rev", "source", "vs_prev"],
             rows, markdown)
     return "\n".join(lines).lstrip("\n")
+
+
+def trend_json(entries: Sequence[dict],
+               metrics: Optional[Sequence[str]] = None,
+               platform: Optional[str] = None,
+               gate_args: Optional[dict] = None) -> dict:
+    """Machine-readable trend: the per-leg trajectory PLUS each leg's
+    sentinel verdict, as one JSON document — so CI archives the trend as
+    an artifact instead of scraping the markdown table. Same grouping/
+    ordering as :func:`trend_tables`; verdicts come from
+    :func:`evaluate_gate` with default (or ``gate_args``) thresholds on
+    each leg's newest label."""
+    gs = groups(entries, metrics, platform)
+    verdicts = {
+        (v["metric"], v["platform"], v["config"]): v
+        for v in evaluate_gate(entries, metrics=metrics, platform=platform,
+                               **(gate_args or {}))
+    }
+    legs = []
+    for (metric, plat, cfg), es in sorted(gs.items()):
+        points = []
+        prev: Optional[float] = None
+        for e in es:
+            points.append({
+                "label": e["label"],
+                "value": e["value"],
+                "unit": e.get("unit"),
+                "rev": e.get("rev"),
+                "source": e["source"],
+                "t": e["t"],
+                "vs_prev": (e["value"] / prev
+                            if prev not in (None, 0) else None),
+            })
+            prev = e["value"]
+        legs.append({
+            "metric": metric,
+            "platform": plat,
+            "config": cfg,
+            "points": points,
+            "verdict": verdicts.get((metric, plat, cfg)),
+        })
+    return {"kind": "perf-trend", "v": 1,
+            "n_entries": len(entries), "legs": legs}
 
 
 def diff_tables(entries: Sequence[dict], label_a: str, label_b: str,
@@ -408,6 +433,12 @@ def main(argv: Optional[list] = None) -> int:
     common(sp, markdown=True)
     sp.add_argument("--metric", action="append", default=[])
     sp.add_argument("--platform", default="")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable output (per-leg trajectory + "
+                         "sentinel verdicts) instead of tables — the "
+                         "CI-artifact shape")
+    sp.add_argument("--out", default="",
+                    help="with --json, also write the document here")
 
     sp = sub.add_parser("diff", help="one label vs another, per leg")
     common(sp, markdown=True)
@@ -472,6 +503,19 @@ def main(argv: Optional[list] = None) -> int:
         return 2
     entries = ledger.load_ledger(args.ledger)
     if args.cmd == "trend":
+        if args.json:
+            if args.markdown:
+                print("# --json ignores --markdown", file=sys.stderr)
+            doc = trend_json(entries, args.metric or None,
+                             args.platform or None)
+            text = json.dumps(doc, indent=1, sort_keys=True)
+            print(text)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(text + "\n")
+            return 0
+        if args.out:
+            print("# trend --out requires --json", file=sys.stderr)
         print(trend_tables(entries, args.metric or None,
                            args.platform or None, markdown=args.markdown))
         return 0

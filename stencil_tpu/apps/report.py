@@ -327,10 +327,46 @@ def follow(paths: List[str], *, interval_s: float = 2.0, count: int = 0,
         return 0
 
 
+def follow_status(path: str, *, interval_s: float = 2.0, count: int = 0,
+                  once: bool = False, out=None) -> int:
+    """The top-like run-status view: render the atomic snapshot file
+    (obs/status.py) once, or re-render it in place every ``interval_s``
+    (``--follow``). A missing/unparseable file is waited for — the view
+    usually starts before the run."""
+    from ..obs import status as status_mod
+
+    out = out or sys.stdout
+    it = 0
+    try:
+        while True:
+            it += 1
+            doc = status_mod.read_status(path)
+            if doc is None:
+                body = f"(waiting for a status snapshot at {path})"
+            else:
+                errs = status_mod.validate_status(doc)
+                body = status_mod.render_status(doc)
+                if errs:
+                    body += f"\n({len(errs)} schema issue(s): {errs[0]})"
+            if once:
+                out.write(body + "\n")
+                return 0 if doc is not None else 1
+            if getattr(out, "isatty", lambda: False)():
+                out.write("\x1b[2J\x1b[H")
+            out.write(f"-- status #{it} @ {time.strftime('%H:%M:%S')} · "
+                      f"{path}\n{body}\n")
+            out.flush()
+            if count and it >= count:
+                return 0
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(
         description="aggregate telemetry metrics JSONL into trimean tables")
-    p.add_argument("paths", nargs="+", help="metrics JSONL file(s)")
+    p.add_argument("paths", nargs="*", help="metrics JSONL file(s)")
     p.add_argument("--markdown", action="store_true",
                    help="markdown tables instead of CSV")
     p.add_argument("--p99", action="store_true",
@@ -350,6 +386,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--follow", action="store_true",
                    help="live tail: re-read growing metrics files and "
                         "re-render in place")
+    p.add_argument("--status", default="",
+                   help="top-like reader of a run-status snapshot file "
+                        "(obs/status.py; written per chunk by the guarded "
+                        "loop's --status-file): renders once, or in place "
+                        "with --follow")
     p.add_argument("--interval", type=float, default=2.0,
                    help="--follow redraw period in seconds")
     p.add_argument("--follow-count", type=int, default=0,
@@ -368,6 +409,19 @@ def main(argv: Optional[list] = None) -> int:
             print(f"# {mode} mode ignores {', '.join(ignored)}",
                   file=sys.stderr)
 
+    if args.status:
+        _warn_ignored("--status", [("--validate", args.validate),
+                                   ("--ledger", args.ledger),
+                                   ("--trace-out", args.trace_out),
+                                   ("--baseline", args.baseline),
+                                   ("--out", args.out),
+                                   ("metrics paths", args.paths)])
+        return follow_status(args.status, interval_s=args.interval,
+                             count=args.follow_count,
+                             once=not args.follow)
+    if not args.paths:
+        p.error("at least one metrics JSONL path is required "
+                "(or --status FILE)")
     if args.follow:
         _warn_ignored("--follow", [("--validate", args.validate),
                                    ("--ledger", args.ledger),

@@ -87,6 +87,13 @@ class TenantJob:
     dtype: str = "float32"
     seed: int = 0
     workload: str = "jacobi"
+    # Optional per-step latency SLO (milliseconds): while the tenant's
+    # lane is live, its ONLINE p99 step latency is tracked against this
+    # deadline and a breach emits one `slo.violation` record (the
+    # SLO-aware scheduling of ROADMAP #4 consumes these; here the
+    # tracking + evidence land). Never joins the bucket — a deadline is
+    # a contract, not a shape.
+    deadline_ms: Optional[float] = None
 
     def bucket(self) -> Tuple[Tuple[int, int, int], str, str]:
         """The shape bucket: jobs in one slot must share it (the compiled
@@ -301,6 +308,9 @@ class CampaignDriver:
         resume: bool = False,
         cache: Optional[CompileCache] = None,
         use_pallas: bool = False,
+        sentinel=None,
+        status=None,
+        slo_min_samples: int = 3,
     ):
         assert slot_size >= 1
         tids = [j.tid for j in jobs]
@@ -330,6 +340,21 @@ class CampaignDriver:
         self.resume = bool(resume)
         self.cache = cache if cache is not None else CompileCache()
         self.use_pallas = bool(use_pallas)
+        # live observability (obs/live.py + obs/status.py): the sentinel
+        # watches per-slot chunk-cycle latencies (keyed per bucket — two
+        # shapes legitimately run at different cadences), the status
+        # writer gets the per-lane tenant table each chunk
+        self.sentinel = sentinel
+        self.status = status
+        # a tenant's online p99 is judged against its deadline only once
+        # this many latency samples exist (a single cold-cache chunk must
+        # not condemn a tenant)
+        self.slo_min_samples = max(1, int(slo_min_samples))
+        # per-tenant online latency samples (bounded — streaming p50/p99
+        # over recent history, the obs/live window discipline) and the
+        # once-per-tenant violation latch
+        self._lane_lat: Dict[str, deque] = {}
+        self._slo_violated: set = set()
 
     # -- per-tenant durable state ---------------------------------------------
     def tenant_dir(self, tid: str) -> str:
@@ -422,10 +447,19 @@ class CampaignDriver:
             "p99_step_s": percentile(lat, 99) if lat else float("nan"),
             "evicted": sorted(t for t, r in results.items()
                               if r.outcome == "fault"),
+            "slo_violations": sorted(self._slo_violated),
+            "anomalies": (self.sentinel.detected_total
+                          if self.sentinel is not None else 0),
             "cache": self.cache.stats(),
         }
+        if self.sentinel is not None:
+            # the campaign's in-run instability lands in the ledger via
+            # the standard gauge-trimean ingest (perf_tool)
+            rec.gauge("live.anomaly_count",
+                      float(self.sentinel.detected_total), phase="live")
         rec.meta("campaign.summary", slots=slot_idx,
                  tenants=len(self.jobs), evicted=len(summary["evicted"]),
+                 slo_violations=len(summary["slo_violations"]),
                  cache_hits=self.cache.hits, cache_misses=self.cache.misses)
         return summary
 
@@ -582,6 +616,62 @@ class CampaignDriver:
             hard_sync(out)
             return out
 
+        def lane_stats(lane: Lane):
+            """(p50_ms, p99_ms) of the lane's tenant over its online
+            latency window, or (None, None) before any sample."""
+            if lane.tenant is None:
+                return None, None
+            samples = self._lane_lat.get(lane.tenant.tid)
+            if not samples:
+                return None, None
+            return (percentile(samples, 50) * 1e3,
+                    percentile(samples, 99) * 1e3)
+
+        def check_slo(done_now: int) -> None:
+            """Judge every live lane's online p99 against its deadline;
+            a breach emits ONE slo.violation (latched per tenant — the
+            evidence record, not a siren)."""
+            for l in lanes:
+                job = l.tenant
+                if job is None or job.deadline_ms is None:
+                    continue
+                samples = self._lane_lat.get(job.tid)
+                if (not samples or len(samples) < self.slo_min_samples
+                        or job.tid in self._slo_violated):
+                    continue
+                p50_ms, p99_ms = lane_stats(l)
+                if p99_ms > job.deadline_ms:
+                    self._slo_violated.add(job.tid)
+                    rec.meta("slo.violation", tenant=job.tid,
+                             step=int(l.tenant_step(done_now)),
+                             lane=l.idx, slot=slot_idx, phase="slo",
+                             deadline_ms=float(job.deadline_ms),
+                             p99_ms=p99_ms, p50_ms=p50_ms,
+                             samples=len(samples))
+                    log.warn(
+                        f"campaign: SLO VIOLATION tenant {job.tid} "
+                        f"(lane {l.idx}): online p99 {p99_ms:.3g} ms > "
+                        f"deadline {job.deadline_ms:g} ms")
+
+        def lane_table(done_now: int):
+            rows = []
+            for l in lanes:
+                job = l.tenant
+                p50_ms, p99_ms = lane_stats(l)
+                rows.append({
+                    "lane": l.idx,
+                    "tenant": job.tid if job else None,
+                    "step": int(l.tenant_step(done_now)) if job else None,
+                    "steps": job.steps if job else None,
+                    "p50_ms": p50_ms,
+                    "p99_ms": p99_ms,
+                    "deadline_ms": job.deadline_ms if job else None,
+                    "slo": (None if job is None or job.deadline_ms is None
+                            else ("violated" if job.tid in self._slo_violated
+                                  else "ok")),
+                })
+            return rows
+
         def on_chunk(st, k, per, done_now):
             nonlocal cell_steps, wall
             n_active = sum(1 for l in lanes if l.tenant is not None)
@@ -590,6 +680,21 @@ class CampaignDriver:
             wall += per * k
             rec.gauge("campaign.step_latency_s", per, phase="step",
                       unit="s", mode="batched", slot=slot_idx, iters=k)
+            # per-tenant online latency: every live lane of the slot
+            # stepped together, so the chunk's per-step wall is each
+            # live tenant's sample
+            for l in lanes:
+                if l.tenant is not None:
+                    self._lane_lat.setdefault(
+                        l.tenant.tid, deque(maxlen=256)).append(per)
+            check_slo(done_now)
+            if self.status is not None:
+                # stage only: run_guarded's per-chunk update (which runs
+                # right after on_chunk) flushes these sections in the
+                # same atomic write
+                self.status.set(
+                    lanes=lane_table(done_now),
+                    slo={"violations": sorted(self._slo_violated)})
 
         def save_fn(s, st):
             nonlocal stash
@@ -631,6 +736,13 @@ class CampaignDriver:
                     on_chunk=on_chunk, spec=None,
                     ckpt_dir=self.campaign_dir,
                     evidence_dir=self.campaign_dir, app="campaign",
+                    sentinel=self.sentinel,
+                    # per-bucket key: two shape buckets run at honestly
+                    # different cadences; base_metric() strips the tag so
+                    # "*"/"step.latency_s" config still applies
+                    sentinel_key=("step.latency_s["
+                                  f"{x}x{y}x{z},{dtype},{workload}]"),
+                    status=self.status,
                 )
             except RecoveryExhausted as e:
                 curr = self._evict(e, spec, lanes, stash, backfill,

@@ -142,6 +142,9 @@ def run_guarded(
     ckpt_dir: Optional[str] = None,
     evidence_dir: Optional[str] = None,
     app: Optional[str] = None,
+    sentinel=None,
+    sentinel_key: str = "step.latency_s",
+    status=None,
 ) -> Tuple[Dict, int]:
     """Drive the step loop from ``start`` to ``iters``; returns the final
     ``(state, step)``.
@@ -161,6 +164,16 @@ def run_guarded(
       aside so the next restore attempt skips it.
     - ``on_chunk(state, k, per_iter_s, step)`` observes each timed chunk
       (statistics, telemetry, dumps); may return a replacement state.
+    - ``sentinel`` (:class:`~stencil_tpu.obs.live.LiveSentinel`) observes
+      each chunk's whole-cycle per-step latency under ``sentinel_key`` —
+      step + injection + health check + checkpoint, deliberately WIDER
+      than the per-chunk step span (an injected slowdown or a slow save
+      must be visible to the in-run sentinel the way it is to the
+      wall-clock ledger leg). Detection emits ``anomaly.detected`` /
+      ``replan.requested`` mid-run.
+    - ``status`` (:class:`~stencil_tpu.obs.status.StatusWriter`) gets an
+      atomic snapshot rewrite per chunk: current step, rolling latency,
+      health counts, anomaly state — the file ``report --status`` polls.
     """
     rec = telemetry.get()
     policy = policy or RecoveryPolicy()
@@ -173,6 +186,29 @@ def run_guarded(
                      "them?)")
     rollbacks: Dict[int, int] = {}
     fault_log: List[dict] = []
+    health_checks = 0
+    # a campaign calls run_guarded once per slot segment on ONE shared
+    # status writer: the health section accumulates on top of whatever
+    # the snapshot already shows, so counts never regress mid-campaign
+    base_health = {"checks": 0, "faults": 0, "rollbacks": 0}
+    if status is not None and isinstance(status.doc.get("health"), dict):
+        prev_h = status.doc["health"]
+        base_health = {k: int(prev_h.get(k, 0)) for k in base_health}
+
+    def _status_update(step: int, per: Optional[float] = None) -> None:
+        if status is None:
+            return
+        status.update(
+            step=int(step), iters=int(iters), per_step_s=per,
+            steps_per_s=(1.0 / per if per and per > 0 else None),
+            health={
+                "checks": base_health["checks"] + health_checks,
+                "faults": base_health["faults"] + len(fault_log),
+                "rollbacks": (base_health["rollbacks"]
+                              + sum(rollbacks.values())),
+            },
+            anomalies=sentinel.summary() if sentinel is not None else None,
+        )
 
     def _abort(fault: NumericalFault, reason: str) -> None:
         payload = {
@@ -206,6 +242,7 @@ def run_guarded(
                 state = step_fn(state, k)
                 per = (time.perf_counter() - t0) / k
                 done = prev + k
+                rec.note_step(done)  # heartbeat payload: last step reached
                 if injector is not None:
                     state = injector.fire_due(state, prev, done, spec=spec,
                                               ckpt_dir=ckpt_dir,
@@ -217,10 +254,24 @@ def run_guarded(
                     # a due save forces a check even off the health cadence:
                     # a poisoned state must never become a rollback target
                     guard.check(state, step=done)
+                    health_checks += 1
                 if save_due:
                     save_fn(done, state)
+                cycle = per
+                if sentinel is not None:
+                    # the whole chunk cycle per step (step + injection +
+                    # health + save): what the run actually sustains —
+                    # an injected slowdown lands HERE, not in `per`
+                    cycle = (time.perf_counter() - t0) / k
+                    sentinel.observe(sentinel_key, cycle, step=done,
+                                     unit="s")
                 if on_chunk is not None:
                     state = on_chunk(state, k, per, done) or state
+                # status AFTER on_chunk: a section owner riding on_chunk
+                # (the campaign driver stages lanes via status.set) gets
+                # its sections into the SAME atomic write — one
+                # fsync+rename per chunk, not two
+                _status_update(done, cycle)
             return state, done
         except NumericalFault as f:
             n = rollbacks.get(f.step, 0) + 1
@@ -277,3 +328,5 @@ def run_guarded(
             log.warn(f"fault: rolled back from step {done} to checkpointed "
                      f"step {rstep}")
             done = rstep
+            rec.note_step(done)
+            _status_update(done)  # the snapshot shows the rollback, live

@@ -22,9 +22,21 @@ Four parts, deliberately decoupled:
 - :mod:`stencil_tpu.obs.trace_export` — metrics JSONL ->
   Chrome-trace/Perfetto timeline JSON (one lane per (run, proc),
   fault/checkpoint instant markers); ``apps/report.py --trace-out``.
+- :mod:`stencil_tpu.obs.live` — the IN-run sentinel: streaming
+  trimean ± MAD anomaly detection over bounded per-metric windows
+  (the perf_tool band semantics applied online), emitting
+  ``anomaly.detected`` / ``anomaly.cleared`` / ``replan.requested``
+  mid-run; fed per-chunk by ``fault/recover.run_guarded`` and the
+  campaign driver.
+- :mod:`stencil_tpu.obs.status` — atomic run-status snapshots (one
+  small JSON rewritten per chunk through tmp+fsync+rename): step,
+  throughput, health counts, anomaly state, per-lane tenant SLO
+  states; ``apps/report.py --status`` is the top-like reader. Pure
+  stdlib by the watchdog contract.
 
 This package intentionally imports nothing at package level so that the
 stdlib-weight modules stay loadable directly.
 """
 
-__all__ = ["telemetry", "watchdog", "ledger", "trace_export"]
+__all__ = ["telemetry", "watchdog", "ledger", "trace_export", "live",
+           "status"]

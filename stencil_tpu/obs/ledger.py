@@ -119,6 +119,11 @@ VOLATILE_CONFIG_KEYS = frozenset({
     "metrics_out", "metrics_dma", "run_id", "out", "prefix", "ckpt_dir",
     "campaign_dir", "plan_db", "inject", "resume", "paraview",
     "paraview_every", "checkpoint_period",
+    # live-observability knobs (obs/live.py + obs/status.py): they change
+    # how a run is WATCHED (sentinel bands, snapshot path, SLO records),
+    # never what it computes — a sentinel-on rerun must land in the same
+    # trend group as its sentinel-off history
+    "status_file", "live_sentinel", "live_config", "deadline_ms",
 })
 
 

@@ -80,6 +80,13 @@ NAME_FIELDS = {
     "compile.cache_hit": (("key", str),),
     "compile.build": (("key", str),),
     "compile.build_s": (("key", str),),
+    # the live-observability vocabulary (obs/live.py + campaign SLO
+    # tracking): in-run anomaly detect/clear, deadline violations, and
+    # the replan trigger ROADMAP #6's hot-swap will consume
+    "anomaly.detected": (("metric", str), ("step", int)),
+    "anomaly.cleared": (("metric", str), ("step", int)),
+    "slo.violation": (("tenant", str), ("step", int)),
+    "replan.requested": (("reason", str), ("step", int)),
 }
 
 
@@ -118,6 +125,12 @@ class Recorder:
         self._hb_seq = 0
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        # progress the heartbeat payload quotes (obs/watchdog contract:
+        # readers that only stat the mtime keep working; JSON-aware ones
+        # can say WHERE the run stalled). Shared with the beat thread —
+        # plain dict reads/writes, races are benign (a beat quotes either
+        # the old or the new step, both true recently).
+        self._progress: Dict[str, object] = {}
 
     @property
     def enabled(self) -> bool:
@@ -181,10 +194,13 @@ class Recorder:
         ``timer.trace_range``.
         """
         t0 = time.perf_counter()
+        prev_span = self._progress.get("span")
+        self._progress["span"] = name  # the heartbeat payload quotes this
         try:
             with timer.timed(bucket or name), timer.trace_range(name):
                 yield
         finally:
+            self._progress["span"] = prev_span
             self.emit("span", name, phase=phase,
                       seconds=time.perf_counter() - t0, **tags)
 
@@ -212,13 +228,26 @@ class Recorder:
         else:
             self._hb_last = time.monotonic()
 
+    def note_step(self, step: int) -> None:
+        """Record the last completed step for the heartbeat payload
+        (the guarded loop calls this per chunk): a stall report can then
+        say "stalled at step 412 in exchange" instead of a bare age."""
+        self._progress["step"] = int(step)
+
     def _touch_hb(self) -> None:
         if not self._hb_path:
             return
+        # the body is a tiny JSON note (last step, current span) the
+        # watchdog's stall report quotes; the LIVENESS contract is still
+        # mtime-only, so pure-stdlib readers that just stat() keep
+        # working and a hand-touched beat file stays a valid beat
+        note = {"t": time.time()}
+        note.update({k: v for k, v in self._progress.items()
+                     if v is not None})
         try:
             with open(self._hb_path, "w") as f:
-                f.write(f"{time.time()}\n")
-        except OSError:
+                f.write(json.dumps(note) + "\n")
+        except (OSError, TypeError, ValueError):
             pass  # a torn-down supervisor must not crash the measurement
 
     def _maybe_beat(self) -> None:

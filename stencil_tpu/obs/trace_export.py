@@ -45,11 +45,18 @@ MARKER_NAMES = frozenset({
     "ckpt.save_skipped",
     "ckpt.restore",
     "ckpt.resumed_from_step",
+    # the live-observability vocabulary (obs/live.py + campaign SLO):
+    # in-run anomaly detect/clear, deadline violations, replan triggers
+    "anomaly.detected",
+    "anomaly.cleared",
+    "slo.violation",
+    "replan.requested",
 })
 
 _LANE_TAGS = ("app", "phase", "method", "batched", "iters", "step",
               "fault_kind", "quantity", "from_step", "to_step", "reason",
-              "seconds", "value", "bytes", "seq", "unit")
+              "seconds", "value", "bytes", "seq", "unit",
+              "metric", "tenant", "deadline_ms", "p99_ms", "lane")
 
 
 def _args(rec: dict) -> dict:

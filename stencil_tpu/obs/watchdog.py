@@ -35,6 +35,7 @@ wedge being supervised lives in JAX backend init.
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import subprocess
@@ -77,6 +78,7 @@ class Attempt:
     stderr_tail: str
     log_path: Optional[str] = None  # archived combined log, if archiving
     metrics_log_path: Optional[str] = None  # archived metrics JSONL (evidence)
+    heartbeat_note: Optional[dict] = None  # last beat's JSON payload (stalls)
 
     def summary(self) -> dict:
         return {
@@ -94,6 +96,31 @@ def _mtime(path: str) -> Optional[float]:
         return os.stat(path).st_mtime
     except OSError:
         return None
+
+
+def read_heartbeat_note(path: str) -> Optional[dict]:
+    """The beat file's optional JSON payload (telemetry writes
+    ``{"t", "step"?, "span"?}``) — None for a missing file or a
+    non-JSON body (a hand-touched beat is still a valid beat: liveness
+    is mtime-only by contract, the payload is a bonus)."""
+    try:
+        with open(path) as f:
+            note = json.loads(f.read(4096))
+    except (OSError, ValueError):
+        return None
+    return note if isinstance(note, dict) else None
+
+
+def format_heartbeat_note(note: Optional[dict]) -> str:
+    """One human phrase from a beat payload: "at step 412 in exchange"."""
+    if not note:
+        return ""
+    parts = []
+    if isinstance(note.get("step"), int):
+        parts.append(f"at step {note['step']}")
+    if isinstance(note.get("span"), str) and note["span"]:
+        parts.append(f"in {note['span']}")
+    return " ".join(parts)
 
 
 def _kill(proc: subprocess.Popen, grace_s: float) -> None:
@@ -160,6 +187,7 @@ def supervise(
     t0 = time.monotonic()
     outcome = OK
     rc: Optional[int] = None
+    hb_note: Optional[dict] = None
     with tempfile.TemporaryFile(mode="w+") as out, \
             tempfile.TemporaryFile(mode="w+") as err:
         proc = subprocess.Popen(cmd, stdout=out, stderr=err, env=env, cwd=cwd)
@@ -194,8 +222,14 @@ def supervise(
                     if stale:
                         outcome = STALL
                         age = "never beat" if mt is None else f"{now - mt:.0f}s stale"
+                        # quote the beat payload's progress note so the
+                        # report says WHERE, not just how stale
+                        hb_note = read_heartbeat_note(hb_path)
+                        where = format_heartbeat_note(hb_note)
                         print(
-                            f"[watchdog] {name} stalled (heartbeat {age}, "
+                            f"[watchdog] {name} stalled"
+                            + (f" {where}" if where else "")
+                            + f" (heartbeat {age}, "
                             f"deadline {heartbeat_timeout_s:.0f}s) after "
                             f"{elapsed:.0f}s; killing",
                             file=sys.stderr, flush=True,
@@ -227,6 +261,7 @@ def supervise(
         stdout=stdout,
         stderr_tail=stderr[-stderr_tail_bytes:],
         log_path=None,
+        heartbeat_note=hb_note,
     )
     if archive_dir:
         # sub-second suffix: back-to-back retries of one name must not

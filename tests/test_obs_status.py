@@ -1,0 +1,364 @@
+"""Run-status snapshots (obs/status.py), the report --status reader,
+the watchdog heartbeat JSON payload, perf_tool trend --json, and the
+campaign driver's deadline/SLO tracking."""
+
+import io
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from stencil_tpu.obs import telemetry, watchdog
+from stencil_tpu.obs.status import (
+    StatusWriter,
+    read_status,
+    render_status,
+    validate_status,
+    write_status,
+)
+
+PY = sys.executable
+
+
+# -- the atomic snapshot file -------------------------------------------------
+
+
+def test_status_round_trip_and_validation(tmp_path):
+    path = str(tmp_path / "status.json")
+    w = StatusWriter(path, app="jacobi3d", run="r-1")
+    doc = w.update(step=4, iters=10, per_step_s=0.01,
+                   health={"checks": 2, "faults": 0, "rollbacks": 0},
+                   anomalies={"active": [], "detected": 0, "cleared": 0})
+    assert validate_status(doc) == []
+    got = read_status(path)
+    assert got["step"] == 4 and got["iters"] == 10
+    assert validate_status(got) == []
+    # updates MERGE: a later partial update keeps earlier sections
+    w.update(step=6)
+    got = read_status(path)
+    assert got["step"] == 6 and got["health"]["checks"] == 2
+
+
+def test_status_write_is_atomic_no_tmp_left_behind(tmp_path):
+    path = str(tmp_path / "s.json")
+    for i in range(5):
+        write_status(path, {"v": 1, "kind": "run-status", "t": time.time(),
+                            "step": i})
+    assert read_status(path)["step"] == 4
+    leftovers = [f for f in os.listdir(tmp_path) if f.startswith(".tmp-")]
+    assert leftovers == []
+
+
+def test_read_status_tolerates_missing_and_garbage(tmp_path):
+    assert read_status(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{torn")
+    assert read_status(str(bad)) is None
+    notdict = tmp_path / "list.json"
+    notdict.write_text("[1, 2]")
+    assert read_status(str(notdict)) is None
+
+
+def test_validate_status_catalogue():
+    base = {"v": 1, "kind": "run-status", "t": 0.0}
+    assert validate_status(base) == []
+    assert validate_status("x")
+    assert validate_status({**base, "v": 2})
+    assert validate_status({**base, "kind": "other"})
+    assert validate_status({**base, "step": "four"})
+    assert validate_status({**base, "health": {"checks": 1}})  # missing keys
+    assert validate_status({**base, "anomalies": {"active": {}}})
+    assert validate_status({**base, "lanes": [{"tenant": "t0"}]})  # no lane
+    assert validate_status(
+        {**base, "lanes": [{"lane": 0, "slo": "maybe"}]})
+    assert validate_status({**base, "slo": {"violations": "t1"}})
+    ok = {**base, "step": 3, "iters": 9, "per_step_s": 0.1,
+          "health": {"checks": 1, "faults": 0, "rollbacks": 0},
+          "anomalies": {"active": [{"metric": "k", "step": 2}],
+                        "detected": 1, "cleared": 0},
+          "lanes": [{"lane": 0, "tenant": "t0", "slo": "ok"},
+                    {"lane": 1, "tenant": None, "slo": None}],
+          "slo": {"violations": ["t1"]}}
+    assert validate_status(ok) == []
+
+
+def test_render_status_reads_like_top():
+    doc = {"v": 1, "kind": "run-status", "run": "r-9", "app": "jacobi3d",
+           "t": time.time(), "step": 412, "iters": 1000,
+           "per_step_s": 0.0123, "outcome": None,
+           "health": {"checks": 12, "faults": 1, "rollbacks": 1},
+           "anomalies": {"active": [
+               {"metric": "step.latency_s", "step": 400, "value": 8.0,
+                "lo": 0.0, "hi": 0.3, "direction": "lower"}],
+               "detected": 1, "cleared": 0},
+           "lanes": [{"lane": 0, "tenant": "t0", "step": 4, "steps": 8,
+                      "p50_ms": 3.0, "p99_ms": 165.0, "deadline_ms": 0.5,
+                      "slo": "violated"},
+                     {"lane": 1, "tenant": None}],
+           "slo": {"violations": ["t0"]}}
+    text = render_status(doc)
+    assert "step 412/1000 (41%)" in text
+    assert "ANOMALY step.latency_s since step 400" in text
+    assert "SLO violations: t0" in text
+    assert "violated" in text and "(dead)" in text
+    assert "faults=1" in text and "1 active" in text
+
+
+def test_report_status_cli_once_and_follow(tmp_path, capsys):
+    from stencil_tpu.apps import report
+
+    path = str(tmp_path / "status.json")
+    # missing snapshot: one-shot mode says waiting, exits 1
+    assert report.main(["--status", path]) == 1
+    assert "waiting for a status snapshot" in capsys.readouterr().out
+    StatusWriter(path, app="jacobi3d", run="r-1").update(step=2, iters=4)
+    assert report.main(["--status", path]) == 0
+    assert "step 2/4" in capsys.readouterr().out
+    # follow mode redraws (bounded by --follow-count)
+    assert report.main(["--status", path, "--follow", "--follow-count", "2",
+                        "--interval", "0.01"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("-- status #") == 2
+
+
+def test_report_without_paths_or_status_errors():
+    from stencil_tpu.apps import report
+
+    with pytest.raises(SystemExit) as e:
+        report.main([])
+    assert e.value.code == 2
+
+
+# -- watchdog heartbeat payload -----------------------------------------------
+
+
+def test_heartbeat_payload_carries_step_and_span(tmp_path, monkeypatch):
+    hb = str(tmp_path / "beat")
+    monkeypatch.setenv(watchdog.HEARTBEAT_FILE_ENV, hb)
+    rec = telemetry.Recorder(sink=None)
+    rec.note_step(412)
+    with rec.span("exchange"):
+        rec.heartbeat()
+        note = watchdog.read_heartbeat_note(hb)
+        assert note["step"] == 412 and note["span"] == "exchange"
+        assert isinstance(note["t"], float)
+    rec.heartbeat()  # span closed: payload drops the span name
+    note = watchdog.read_heartbeat_note(hb)
+    assert note["step"] == 412 and "span" not in note
+    assert watchdog.format_heartbeat_note(note) == "at step 412"
+    assert watchdog.format_heartbeat_note(
+        {"step": 3, "span": "exchange"}) == "at step 3 in exchange"
+    assert watchdog.format_heartbeat_note(None) == ""
+
+
+def test_heartbeat_mtime_contract_survives_plain_touch(tmp_path):
+    # the PURE-STDLIB contract: a beat body that is not JSON is still a
+    # beat (liveness is mtime-only); the note reader just returns None
+    hb = tmp_path / "beat"
+    hb.write_text(str(time.time()))
+    assert watchdog.read_heartbeat_note(str(hb)) is None
+
+
+def test_supervise_stall_report_quotes_the_payload(tmp_path, capfd):
+    """The satellite's acceptance line: "stalled at step 412 in
+    exchange", not a bare stale-mtime age."""
+    child = textwrap.dedent(
+        """
+        import json, os, time
+        hb = os.environ["STENCIL_HEARTBEAT_FILE"]
+        with open(hb, "w") as f:
+            json.dump({"t": time.time(), "step": 412, "span": "exchange"}, f)
+        time.sleep(300)
+        """
+    )
+    att = watchdog.supervise(
+        [PY, "-c", child], timeout_s=120, heartbeat_timeout_s=1.5,
+        first_beat_grace_s=60, poll_s=0.1, name="stall-note")
+    assert att.outcome == watchdog.STALL
+    assert att.heartbeat_note == {"t": pytest.approx(
+        att.heartbeat_note["t"]), "step": 412, "span": "exchange"}
+    err = capfd.readouterr().err
+    assert "stalled at step 412 in exchange" in err
+
+
+# -- perf_tool trend --json ---------------------------------------------------
+
+
+def _seed_ledger(path):
+    from stencil_tpu.obs import ledger
+
+    entries = [
+        ledger.make_entry("leg_a_s", v, label=f"r{i + 1:02d}", unit="s",
+                          platform="cpu", config="cfg0", source="manual",
+                          t=1000.0 + i)
+        for i, v in enumerate([1.0, 1.1, 0.9, 5.0])
+    ]
+    ledger.append_entries(path, entries)
+    return entries
+
+
+def test_trend_json_trajectory_and_verdicts(tmp_path, capsys):
+    from stencil_tpu.apps import perf_tool
+
+    path = str(tmp_path / "ledger.jsonl")
+    _seed_ledger(path)
+    out_file = str(tmp_path / "trend.json")
+    rc = perf_tool.main(["trend", "--ledger", path, "--json",
+                         "--out", out_file])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc == json.load(open(out_file))
+    assert doc["kind"] == "perf-trend" and doc["v"] == 1
+    (leg,) = doc["legs"]
+    assert leg["metric"] == "leg_a_s" and leg["platform"] == "cpu"
+    labels = [pt["label"] for pt in leg["points"]]
+    assert labels == ["r01", "r02", "r03", "r04"]
+    assert leg["points"][0]["vs_prev"] is None
+    assert leg["points"][1]["vs_prev"] == pytest.approx(1.1)
+    # the newest label (r04: 5.0 s on a seconds leg) trips the verdict
+    assert leg["verdict"]["status"] == "fail"
+    assert leg["verdict"]["label"] == "r04"
+
+
+def test_trend_json_is_machine_parseable_with_filters(tmp_path, capsys):
+    from stencil_tpu.apps import perf_tool
+
+    path = str(tmp_path / "ledger.jsonl")
+    _seed_ledger(path)
+    rc = perf_tool.main(["trend", "--ledger", path, "--json",
+                         "--metric", "no_such_leg"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["legs"] == []
+
+
+# -- campaign deadlines / SLO -------------------------------------------------
+
+
+def test_parse_deadlines_grammar():
+    from stencil_tpu.apps.campaign import parse_deadlines
+
+    assert parse_deadlines("") == {}
+    assert parse_deadlines("50") == {"*": 50.0}
+    assert parse_deadlines("t1=0.5,t3=100") == {"t1": 0.5, "t3": 100.0}
+    assert parse_deadlines("*=10,t1=0.5") == {"*": 10.0, "t1": 0.5}
+    with pytest.raises(ValueError):
+        parse_deadlines("t1=fast")
+    # nan/inf/zero parse as floats but can never be judged (p99 > nan is
+    # always False) — rejected loudly instead of running un-judged
+    for bad in ("t1=nan", "t1=inf", "0", "t1=-5"):
+        with pytest.raises(ValueError):
+            parse_deadlines(bad)
+
+
+def test_campaign_cli_rejects_unjudgeable_configs():
+    from stencil_tpu.apps import campaign
+
+    # a mistyped tenant id must not run the campaign un-judged
+    with pytest.raises(SystemExit) as e:
+        campaign.main(["--tenants", "4", "--deadline-ms", "t9=5"])
+    assert e.value.code == 2
+    # the live layer rides the guarded batched driver: sequential mode
+    # would silently observe nothing
+    with pytest.raises(SystemExit) as e:
+        campaign.main(["--mode", "sequential", "--live-sentinel"])
+    assert e.value.code == 2
+
+
+def test_campaign_sequential_ignores_env_status_file(tmp_path, capsys):
+    """--status-file may come from the globally-exported
+    STENCIL_STATUS_FILE the user never typed: sequential mode must warn
+    and ignore it, not break every invocation in that environment."""
+    from stencil_tpu.apps import campaign
+
+    status = tmp_path / "status.json"
+    rc = campaign.main(["--mode", "sequential", "--tenants", "1",
+                        "--size", "8", "--steps", "2",
+                        "--status-file", str(status)])
+    assert rc == 0
+    assert not status.exists()  # ignored, loudly (log.warn), not half-used
+
+
+def test_live_config_errors_are_clean(tmp_path):
+    from stencil_tpu.apps import jacobi3d
+    from stencil_tpu.apps._bench_common import load_live_config
+
+    assert load_live_config("") == {}
+    assert load_live_config('{"*": {"rel_tol": 1.0}}') == {
+        "*": {"rel_tol": 1.0}}
+    cfg = tmp_path / "live.json"
+    cfg.write_text('{"step.latency_s": {"mad_k": 5}}')
+    assert load_live_config(str(cfg)) == {"step.latency_s": {"mad_k": 5}}
+    with pytest.raises(ValueError):
+        load_live_config("[1]")
+    # a mistyped path/JSON is an argparse error at the CLI, not a
+    # traceback after backend init
+    with pytest.raises(SystemExit) as e:
+        jacobi3d.main(["--live-sentinel", "--live-config", "no-such.json"])
+    assert e.value.code == 2
+
+
+def test_status_set_stages_without_flushing(tmp_path):
+    path = str(tmp_path / "s.json")
+    w = StatusWriter(path, app="campaign", run="r-1")
+    w.set(lanes=[{"lane": 0, "tenant": "t0"}])
+    assert not os.path.exists(path)  # staged only — no write yet
+    w.update(step=3)
+    got = read_status(path)
+    # the staged section rode the one atomic write
+    assert got["step"] == 3 and got["lanes"][0]["tenant"] == "t0"
+
+
+def test_campaign_driver_slo_violation_and_lanes(tmp_path):
+    """A deadline-doomed tenant emits exactly one slo.violation while
+    its slot siblings stay clean, and the status lanes carry the online
+    p50/p99 + verdict."""
+    from stencil_tpu.campaign import CampaignDriver, TenantJob
+
+    sink = io.StringIO()
+    rec = telemetry.Recorder(sink=sink)
+    old = telemetry._recorder
+    telemetry._recorder = rec
+    try:
+        jobs = [
+            TenantJob("t0", (8, 8, 8), 8, seed=1),
+            TenantJob("t1", (8, 8, 8), 8, seed=2, deadline_ms=1e-4),
+            TenantJob("t2", (8, 8, 8), 8, seed=3, deadline_ms=1e9),
+        ]
+        status = StatusWriter(str(tmp_path / "status.json"), app="campaign",
+                              run=rec.run_id)
+        drv = CampaignDriver(jobs, 4, str(tmp_path / "c"), chunk=2,
+                             status=status, slo_min_samples=2)
+        summary = drv.run()
+        assert summary["slo_violations"] == ["t1"]
+        recs = [json.loads(line) for line in sink.getvalue().splitlines()]
+        viol = [r for r in recs if r["name"] == "slo.violation"]
+        assert len(viol) == 1  # latched: one evidence record, not a siren
+        v = viol[0]
+        assert telemetry.validate_record(v) == []
+        assert v["tenant"] == "t1" and v["p99_ms"] > v["deadline_ms"]
+        doc = read_status(str(tmp_path / "status.json"))
+        assert validate_status(doc) == []
+        by_tenant = {ln["tenant"]: ln for ln in doc["lanes"]}
+        assert by_tenant["t1"]["slo"] == "violated"
+        assert by_tenant["t2"]["slo"] == "ok"       # generous deadline holds
+        assert by_tenant["t0"]["slo"] is None       # no deadline, no verdict
+        assert by_tenant["t1"]["p99_ms"] > 0
+        assert doc["slo"] == {"violations": ["t1"]}
+        # every tenant still completes (an SLO breach is evidence, not
+        # an eviction)
+        assert sorted(summary["results"]) == ["t0", "t1", "t2"]
+        assert all(r.outcome == "done" for r in summary["results"].values())
+    finally:
+        telemetry._recorder = old
+
+
+def test_deadline_never_joins_the_bucket():
+    from stencil_tpu.campaign import TenantJob
+
+    a = TenantJob("a", (8, 8, 8), 4, deadline_ms=1.0)
+    b = TenantJob("b", (8, 8, 8), 4, deadline_ms=None)
+    assert a.bucket() == b.bucket()
