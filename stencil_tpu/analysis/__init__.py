@@ -1,0 +1,30 @@
+"""Static analysis of the repo's own load-bearing contracts.
+
+The reference enforces its invariants mechanically — every CUDA/NVML
+call goes through the ``CUDA_RUNTIME()``/``NVML()`` checking macros —
+while this repo's contracts historically lived in prose and scattered
+test pins. This package makes them machine-checked:
+
+- :mod:`.astlint` — an AST-walking lint engine with repo-specific rules
+  (pure-stdlib file-path-loaded modules, the telemetry name vocabulary,
+  the tmp+fsync+rename atomic-write protocol, ``assert``-for-validation
+  in public APIs, unprefixed ``{placeholder}`` strings at raise/log
+  sites, host syncs inside traced step-loop code);
+- :mod:`.verify_plan` — the ExchangePlan IR vs compiled-HLO conformance
+  auditor: sweeps partition x method x dtype x Q configs and cross-checks
+  the IR's census/byte/DMA predictions against the compiled truth;
+- :mod:`.jit_audit` — the step-loop audit: a guarded loop run under
+  ``jax.transfer_guard`` + a compile counter, failing on any post-warmup
+  recompilation or implicit device-to-host transfer.
+
+Front end: ``python -m stencil_tpu.apps.lint_tool {lint,verify-plan,
+jit-audit,all}``; CI gate: ``scripts/ci_static_gate.py``.
+"""
+
+from .astlint import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)

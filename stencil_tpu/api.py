@@ -103,7 +103,8 @@ class DistributedDomain:
 
     def add_data(self, name: str = "", dtype="float32") -> DataHandle:
         """Register a quantity (reference: stencil.hpp:128)."""
-        assert not self._realized
+        if self._realized:
+            raise RuntimeError("add_data after realize()")
         idx = len(self._names)
         self._names.append(name or f"data{idx}")
         self._dtypes.append(str(jnp.dtype(dtype)))
@@ -419,7 +420,8 @@ class DistributedDomain:
         programs, and any recorded performance, differ)."""
         from .plan.ir import PlanChoice, PlanConfig
 
-        assert self._realized, "plan_meta requires realize()"
+        if not self._realized:
+            raise RuntimeError("plan_meta requires realize()")
         devs = self.mesh.devices.flatten()
         cfg = PlanConfig.make(self.size, self.radius, self._dtypes,
                               len(devs), devs[0].platform)
@@ -554,7 +556,8 @@ class DistributedDomain:
         from .ckpt import assemble_global, check_compatible, find_resume
         from .obs import telemetry
 
-        assert self._realized, "restore_checkpoint requires realize()"
+        if not self._realized:
+            raise RuntimeError("restore_checkpoint requires realize()")
         if jax.process_count() > 1:
             telemetry.get().counter(
                 "ckpt.restore_skipped", value=1, phase="ckpt",
@@ -610,7 +613,8 @@ class DistributedDomain:
         ``--health-every`` / ``--max-rollbacks`` knobs."""
         from .fault.health import HealthGuard
 
-        assert self._realized, "check_health requires realize()"
+        if not self._realized:
+            raise RuntimeError("check_health requires realize()")
         g = getattr(self, "_health_guard", None)
         if g is None:
             g = self._health_guard = HealthGuard(every=1, max_abs=max_abs)

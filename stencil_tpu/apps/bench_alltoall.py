@@ -71,7 +71,8 @@ def run(
 ) -> list:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
-    assert n >= 2, "all-to-all needs at least 2 devices"
+    if n < 2:
+        raise ValueError("all-to-all needs at least 2 devices")
     mesh = Mesh(np.asarray(devices), ("i",))
     rows = []
     for strategy, make_body in (("all_to_all", _alltoall_body), ("ring", _ring_body)):

@@ -186,7 +186,8 @@ def record_base(devices=None, iters: int = 360, path: str = "") -> dict:
     dispatch, which would dominate any per-10-iteration chunk (a first
     recording with chunk 10 read 5x slow across the board)."""
     devices = list(devices) if devices is not None else jax.devices()
-    assert len(devices) == 1, "--record-base wants exactly one device"
+    if len(devices) != 1:
+        raise ValueError("--record-base wants exactly one device")
     chunk = max(1, iters // 3)
     c2 = bench_exchange.run(256, 256, 256, iters=iters, quantities=4,
                             devices=devices, chunk=chunk)[-1]  # "uniform/2"
@@ -201,8 +202,14 @@ def record_base(devices=None, iters: int = 360, path: str = "") -> dict:
         "config2_trimean_s": c2["trimean_s"],
     }
     path = path or _base_path()
-    with open(path, "w") as f:
+    # tmp+fsync+rename: the recorded base anchors every later weak-scaling
+    # column — a torn write must never replace a good one
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(base, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
     log.info(f"single-chip base recorded to {path}: {base}")
     return base
 

@@ -241,9 +241,9 @@ def make_astaroth_step(
     ``shift``) so the A/B runs without touching call sites."""
     spec = ex.spec
     r = spec.radius
-    assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
-        "astaroth needs face radius >= 3 (6th-order stencils)"
-    )
+    if min(r.y(-1), r.y(1), r.z(-1), r.z(1)) < 3:
+        raise ValueError("astaroth needs face radius >= 3 (6th-order "
+                         "stencils)")
     pallas_on = uses_pallas(ex, use_pallas, dtype)
     tight_x = min(r.x(-1), r.x(1)) < 3
     if tight_x:
@@ -252,14 +252,19 @@ def make_astaroth_step(
         # rolls), and only on a single-BLOCK x axis — y/z may have any
         # number of blocks (their overlap shells integrate over x-wrapped
         # slabs, _integrate_shell_wrap_x)
-        assert r.x(-1) == 0 and r.x(1) == 0 and spec.dim.x == 1, (
-            "x radius must be 3+ (inline halos) or exactly 0 (tight layout, "
-            "single-block x axis)"
-        )
-        assert spec.is_uniform(), (
-            "tight-x with multi-block y/z requires uniform splits"
-        )
-        assert pallas_on, "tight-x astaroth requires the fused Pallas path"
+        if not (r.x(-1) == 0 and r.x(1) == 0 and spec.dim.x == 1):
+            raise ValueError(
+                "x radius must be 3+ (inline halos) or exactly 0 (tight "
+                "layout, single-block x axis)"
+            )
+        if not spec.is_uniform():
+            raise ValueError(
+                "tight-x with multi-block y/z requires uniform splits"
+            )
+        if not pallas_on:
+            raise ValueError(
+                "tight-x astaroth requires the fused Pallas path"
+            )
     inv_ds = (
         info.real_params["AC_inv_dsx"],
         info.real_params["AC_inv_dsy"],
@@ -501,12 +506,14 @@ def make_batched_astaroth_step(spec, info: AcMeshInfo, dt: float = 1e-8,
     from ..ops.halo_fill import wrap_fill_batched
 
     r = spec.radius
-    assert spec.dim == _D3(1, 1, 1), (
-        f"batched tenants are single-block domains; got partition {spec.dim}"
-    )
-    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 3, (
-        "astaroth needs face radius >= 3 (6th-order stencils)"
-    )
+    if spec.dim != _D3(1, 1, 1):
+        raise ValueError(
+            f"batched tenants are single-block domains; got partition "
+            f"{spec.dim}"
+        )
+    if min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) < 3:
+        raise ValueError("astaroth needs face radius >= 3 (6th-order "
+                         "stencils)")
     inv_ds = (
         info.real_params["AC_inv_dsx"],
         info.real_params["AC_inv_dsy"],

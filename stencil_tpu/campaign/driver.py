@@ -130,7 +130,8 @@ class Lane:
         return self.start_tenant_step + (slot_step - self.start_slot_step)
 
     def end_slot_step(self) -> int:
-        assert self.tenant is not None
+        if self.tenant is None:
+            raise RuntimeError("end_slot_step on an empty (dead) lane")
         return self.start_slot_step + (self.tenant.steps
                                        - self.start_tenant_step)
 
@@ -230,10 +231,11 @@ class _AstarothWorkload:
                    batch: int, use_pallas: bool):
         from ..astaroth.integrate import make_batched_astaroth_step
 
-        assert not use_pallas, (
-            "astaroth campaigns run the XLA batched step (the batched "
-            "Pallas substep is a hardware-session follow-up)"
-        )
+        if use_pallas:
+            raise ValueError(
+                "astaroth campaigns run the XLA batched step (the batched "
+                "Pallas substep is a hardware-session follow-up)"
+            )
         return make_batched_astaroth_step(spec, self._info(spec),
                                           dt=self.dt, iters=iters,
                                           sharding=sharding)
@@ -312,9 +314,11 @@ class CampaignDriver:
         status=None,
         slo_min_samples: int = 3,
     ):
-        assert slot_size >= 1
+        if slot_size < 1:
+            raise ValueError(f"slot_size must be >= 1, got {slot_size}")
         tids = [j.tid for j in jobs]
-        assert len(set(tids)) == len(tids), "tenant ids must be unique"
+        if len(set(tids)) != len(tids):
+            raise ValueError("tenant ids must be unique")
         self.jobs = list(jobs)
         self.slot_size = int(slot_size)
         self.campaign_dir = campaign_dir

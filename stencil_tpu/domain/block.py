@@ -70,11 +70,13 @@ class LocalBlock:
 
     # -- setup (reference: local_domain.cuh:85-107) -------------------------
     def set_radius(self, radius: Radius) -> None:
-        assert not self._realized
+        if self._realized:
+            raise RuntimeError("set_radius after realize")
         self.radius = radius
 
     def add_data(self, name: str = "", dtype="float32") -> DataHandle:
-        assert not self._realized, "add_data after realize"
+        if self._realized:
+            raise RuntimeError("add_data after realize")
         h = DataHandle(len(self._handles), name or f"q{len(self._handles)}", str(jnp.dtype(dtype)))
         self._handles.append(h)
         return h
@@ -114,13 +116,19 @@ class LocalBlock:
         return self._next[h.idx]
 
     def set_curr(self, h: DataHandle, arr) -> None:
-        assert arr.shape == self.raw_size().as_tuple()[::-1], (
-            f"shape {arr.shape} != padded {self.raw_size().as_tuple()[::-1]}"
-        )
+        if arr.shape != self.raw_size().as_tuple()[::-1]:
+            raise ValueError(
+                f"shape {arr.shape} != padded "
+                f"{self.raw_size().as_tuple()[::-1]}"
+            )
         self._curr[h.idx] = arr
 
     def set_next(self, h: DataHandle, arr) -> None:
-        assert arr.shape == self.raw_size().as_tuple()[::-1]
+        if arr.shape != self.raw_size().as_tuple()[::-1]:
+            raise ValueError(
+                f"shape {arr.shape} != padded "
+                f"{self.raw_size().as_tuple()[::-1]}"
+            )
         self._next[h.idx] = arr
 
     def curr_tree(self) -> Dict[int, jnp.ndarray]:

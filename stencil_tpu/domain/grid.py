@@ -58,10 +58,10 @@ class GridSpec:
 
     def __post_init__(self):
         g, d = self.global_size, self.dim
-        assert d.x >= 1 and d.y >= 1 and d.z >= 1
-        assert g.x >= d.x and g.y >= d.y and g.z >= d.z, (
-            f"global {g} too small for partition {d}"
-        )
+        if not (d.x >= 1 and d.y >= 1 and d.z >= 1):
+            raise ValueError(f"partition {d} needs >= 1 block per axis")
+        if not (g.x >= d.x and g.y >= d.y and g.z >= d.z):
+            raise ValueError(f"global {g} too small for partition {d}")
         base = Dim3(-(-g.x // d.x), -(-g.y // d.y), -(-g.z // d.z))
         object.__setattr__(self, "base", base)
         object.__setattr__(self, "sizes_x", _axis_sizes(g.x, d.x, base.x))

@@ -140,13 +140,15 @@ class RankPartition:
         """x-fastest linear index (reference: partition.hpp:89-101)."""
         idx = Dim3.of(idx)
         d = self._dim
-        assert 0 <= idx.x < d.x and 0 <= idx.y < d.y and 0 <= idx.z < d.z
+        if not (0 <= idx.x < d.x and 0 <= idx.y < d.y and 0 <= idx.z < d.z):
+            raise IndexError(f"block index {idx} outside partition {d}")
         return idx.x + idx.y * d.x + idx.z * d.y * d.x
 
     def dimensionize(self, i: int) -> Dim3:
         """Reference: partition.hpp:104-115."""
         d = self._dim
-        assert 0 <= i < d.flatten()
+        if not 0 <= i < d.flatten():
+            raise IndexError(f"linear index {i} outside partition {d}")
         x = i % d.x
         i //= d.x
         y = i % d.y

@@ -87,7 +87,62 @@ NAME_FIELDS = {
     "anomaly.cleared": (("metric", str), ("step", int)),
     "slo.violation": (("tenant", str), ("step", int)),
     "replan.requested": (("reason", str), ("step", int)),
+    # the static-analysis vocabulary (stencil_tpu/analysis/): per-config
+    # plan-auditor verdicts, the audit summaries the CI static gate
+    # archives, and the lint summary — schema-gated like every other
+    # subsystem's records
+    "analysis.plan_verdict": (("method", str), ("ok", int)),
+    "analysis.plan_mismatch": (("method", str),),
+    "analysis.plan_sweep": (("checked", int), ("failed", int),
+                            ("skipped", int)),
+    "analysis.jit_audit": (("ok", int), ("recompiles", int),
+                           ("transfers", int)),
+    "analysis.lint": (("findings", int), ("new", int)),
 }
+
+# The sanctioned metric-name vocabulary: every LITERAL name the library
+# passes to a Recorder record site (span/counter/gauge/meta/emit). The
+# repo lint's `telemetry-vocab` rule (analysis/astlint.py) checks record
+# sites against this set, so a typo'd metric name fails the static gate
+# instead of silently validating (schema v1 constrains record SHAPE, not
+# names — a `recover.rollbck` counter is a perfectly valid record that no
+# dashboard will ever aggregate). Dynamically-built names (f-strings like
+# ``census.{kind}``/``timer.{k}``/``dma.{kernel}.*``) are explicitly
+# generic and exempt from the check. Grow this list alongside new
+# subsystems — the lint names the site that needs the entry.
+KNOWN_NAMES = frozenset(NAME_FIELDS) | frozenset({
+    "ablate.bit_for_bit_agreement",
+    "analysis.verify_plan", "analysis.jit_warmup", "analysis.jit_audit_loop",
+    "astaroth.exch_trimean_s", "astaroth.exchange", "astaroth.init",
+    "astaroth.iter", "astaroth.iter_trimean_s", "astaroth.warmup",
+    "batched_ab.bit_for_bit_agreement", "batched_ab.q_independent",
+    "bench_alltoall.gb_per_s", "bench_link.gb_per_s", "bench_pack.gb_per_s",
+    "ckpt.bytes_read", "ckpt.bytes_written", "ckpt.files_written",
+    "ckpt.quarantined", "ckpt.restore", "ckpt.restore_skipped",
+    "ckpt.resumed", "ckpt.resumed_from_step", "ckpt.save", "ckpt.write",
+    "config",
+    "dma.capture_error", "dma.skipped",
+    "exchange.bytes_logical", "exchange.bytes_moved",
+    "exchange.bytes_on_wire", "exchange.bytes_on_wire_per_quantity",
+    "exchange.gb_per_s", "exchange.iter", "exchange.permutes_per_quantity",
+    "exchange.trimean_s", "exchange.warmup",
+    "hb",
+    "jacobi.exchange", "jacobi.exchange_bytes", "jacobi.exchange_warmup",
+    "jacobi.init", "jacobi.iter", "jacobi.iter_trimean_s",
+    "jacobi.loop_wall_s", "jacobi.mcells_per_s", "jacobi.mcells_per_s_per_dev",
+    "jacobi.warmup",
+    "live.anomaly_count",
+    "machine", "machine.bandwidth_matrix", "machine.device",
+    "machine.distance_matrix", "machine.partition",
+    "overlap.hidden_frac",
+    "pingpong.gb_per_s", "pingpong.latency_us",
+    "plan.autotune", "plan.cache_hit", "plan.candidates", "plan.chosen",
+    "plan.probe", "plan.probe_trimean_s", "plan.probes_run",
+    "qap.cost", "qap.solve_s",
+    "recover.backoff_s",
+    "wire_ab.bytes_ratio", "wire_ab.max_abs_err", "wire_ab.max_rel_err",
+    "wire_ab.max_ulp_err",
+})
 
 
 def new_run_id() -> str:

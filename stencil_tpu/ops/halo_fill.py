@@ -247,8 +247,16 @@ def make_self_fill(spec: GridSpec, axis: str, vma=None, interpret: bool = False,
     one kernel fills every resident block's halo in place (VERDICT r4
     item 7; the reference runs its same-GPU fast path under
     oversubscription too, tx_cuda.cuh:41-113)."""
-    assert self_fill_supported(spec, axis, jnp.float32, z_stack)
-    assert 1 <= nq <= max_fill_group(spec) or axis != "x", (nq, axis)
+    if not self_fill_supported(spec, axis, jnp.float32, z_stack):
+        raise ValueError(
+            f"self-wrap fill unsupported for axis {axis!r} on this spec "
+            f"(z_stack={z_stack})"
+        )
+    if axis == "x" and not 1 <= nq <= max_fill_group(spec):
+        raise ValueError(
+            f"x-phase fill group size {nq} outside "
+            f"[1, {max_fill_group(spec)}]"
+        )
     p = spec.padded()
     pz, py, px = p.z * z_stack, p.y, p.x
     o, sz, (rm, rp) = _axis_geom(spec, axis)

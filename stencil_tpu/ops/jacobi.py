@@ -160,9 +160,9 @@ def jacobi6_block(block, radius: Radius, masks=None):
     """One full-compute-region Jacobi sweep over a padded block, in place of
     the halo ring (reference kernel over the whole region,
     bin/jacobi3d.cu:343-360)."""
-    assert min(
-        radius.x(-1), radius.x(1), radius.y(-1), radius.y(1), radius.z(-1), radius.z(1)
-    ) >= 1, "jacobi needs face radius >= 1"
+    if min(radius.x(-1), radius.x(1), radius.y(-1), radius.y(1),
+           radius.z(-1), radius.z(1)) < 1:
+        raise ValueError("jacobi needs face radius >= 1")
     *_, pz, py, px = block.shape
     off = Dim3(radius.x(-1), radius.y(-1), radius.z(-1))
     hi = Dim3(px - radius.x(1), py - radius.y(1), pz - radius.z(1))
@@ -777,15 +777,15 @@ def make_batched_jacobi_loop(spec, iters: int, *, sharding=None,
     """
     from ..geometry import Dim3 as _D3
 
-    assert spec.dim == _D3(1, 1, 1), (
-        "batched tenants are single-block domains; got partition "
-        f"{spec.dim} (spatial decomposition and tenant batching do not "
-        "compose yet)"
-    )
+    if spec.dim != _D3(1, 1, 1):
+        raise ValueError(
+            "batched tenants are single-block domains; got partition "
+            f"{spec.dim} (spatial decomposition and tenant batching do "
+            "not compose yet)"
+        )
     r = spec.radius
-    assert min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
-        "jacobi needs face radius >= 1 on every side"
-    )
+    if min(r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)) < 1:
+        raise ValueError("jacobi needs face radius >= 1 on every side")
     off = spec.compute_offset()
     compute = Rect3(off, off + spec.base)
 
@@ -793,9 +793,8 @@ def make_batched_jacobi_loop(spec, iters: int, *, sharding=None,
     if use_pallas:
         from .pallas_stencil import make_pallas_jacobi_sweep, sel_z_range
 
-        assert batch is not None and batch >= 1, (
-            "use_pallas needs the static batch size"
-        )
+        if batch is None or batch < 1:
+            raise ValueError("use_pallas needs the static batch size")
         pallas_sweep = make_pallas_jacobi_sweep(
             spec, sel_z_range(spec), wrap=(True, True, True),
             batch=batch, interpret=interpret,

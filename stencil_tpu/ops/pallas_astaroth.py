@@ -217,17 +217,24 @@ def make_pallas_substep(
     ``curr8``/``out8`` are tuples ordered like :data:`FIELDS`.
     ``variant``: ``"shift"`` (plane-copy window shifts) or ``"ring"``
     (shift-free modular-slot rotation) — see the module docstring."""
-    assert substep_supported(spec, jnp.float32)
-    assert variant in ("shift", "ring"), variant
+    if not substep_supported(spec, jnp.float32):
+        raise ValueError("pallas astaroth substep unsupported on this spec")
+    if variant not in ("shift", "ring"):
+        raise ValueError(f"unknown substep variant {variant!r}")
     ring = variant == "ring"
-    assert not (ring and _skip_shift), "_skip_shift probes the shift variant"
+    if ring and _skip_shift:
+        raise ValueError("_skip_shift probes the shift variant")
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     off = spec.compute_offset()
     zo, yo, xo = off.z, off.y, off.x
     nz, ny, nx = spec.base.z, spec.base.y, spec.base.x
     tz, ty = tiles if tiles is not None else pick_tiles(spec)
-    assert tz >= 1 and nz % tz == 0 and ny % ty == 0 and ty % 8 == 0, (tz, ty)
+    if not (tz >= 1 and nz % tz == 0 and ny % ty == 0 and ty % 8 == 0):
+        raise ValueError(
+            f"tile sizes ({tz}, {ty}) must divide block "
+            f"({nz}, {ny}) with ty a multiple of 8"
+        )
     n_tz, n_ty = nz // tz, ny // ty
     n_tiles = n_tz * n_ty
     rows_in = ty + 16  # y window [y0-8, y0+ty+8): +-3 halo rows, 8-aligned

@@ -146,7 +146,8 @@ def make_pallas_jacobi_sweep(
     stores before the next tenant's pass begins, so no DMA crosses the
     batch axis.
     """
-    assert spec.aligned, "pallas sweep requires GridSpec(aligned=True)"
+    if not spec.aligned:
+        raise ValueError("pallas sweep requires GridSpec(aligned=True)")
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     off = spec.compute_offset()
@@ -493,7 +494,8 @@ def make_pallas_jacobi_multistep(
     skips the per-stage y-ring fills, so the kernel computes WRONG results.
     """
     if rows is not None:
-        assert not _skip_yfill, "_skip_yfill probes the full-plane y rings"
+        if _skip_yfill:
+            raise ValueError("_skip_yfill probes the full-plane y rings")
         return _make_multistep_row_tiled(
             spec, k, rows, interpret=interpret, vma=vma
         )
@@ -502,7 +504,8 @@ def make_pallas_jacobi_multistep(
 
         _log.warn("make_pallas_jacobi_multistep(_skip_yfill=True): "
                   "TIMING PROBE ONLY — results are WRONG by construction")
-    assert spec.aligned
+    if not spec.aligned:
+        raise ValueError("pallas multistep requires GridSpec(aligned=True)")
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     off = spec.compute_offset()
@@ -512,14 +515,19 @@ def make_pallas_jacobi_multistep(
     use_org = mz or my or mx
     r = spec.radius
     if use_org:
-        assert spec.is_uniform(), "deep-halo multistep requires a uniform partition"
+        if not spec.is_uniform():
+            raise ValueError(
+                "deep-halo multistep requires a uniform partition")
         for m, rl, rh in (
             (mz, r.z(-1), r.z(1)), (my, r.y(-1), r.y(1)), (mx, r.x(-1), r.x(1))
         ):
-            assert not m or (rl >= k and rh >= k), (
-                "deep-halo multistep needs radius >= k on multi-block axes"
-            )
-    assert nz >= 2 * k + 1, "domain too shallow for this temporal depth"
+            if m and (rl < k or rh < k):
+                raise ValueError(
+                    "deep-halo multistep needs radius >= k on "
+                    "multi-block axes"
+                )
+    if nz < 2 * k + 1:
+        raise ValueError("domain too shallow for this temporal depth")
     J = nz + 2 * k  # pipeline steps: input vplanes -k .. nz+k-1
     g = spec.global_size
     hot_c = (g.x // 3, g.y // 2, g.z // 2)

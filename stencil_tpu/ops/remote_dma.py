@@ -68,7 +68,11 @@ def make_remote_axis_kernel(spec, phase, nq: int, dtype,
     delivering both boundary slabs of one axis phase via remote DMA.
     ``phase`` is the plan's RemoteDmaPhaseIR; ``phase.ring > 1`` required
     (self-wrap phases are pure local copies — no DMA to issue)."""
-    assert phase.ring > 1 and phase.active
+    if not (phase.ring > 1 and phase.active):
+        raise ValueError(
+            "remote axis kernel needs a multi-device active phase "
+            "(self-wrap phases are pure local copies — no DMA to issue)"
+        )
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     rm, rp, off = phase.rm, phase.rp, phase.offset

@@ -234,7 +234,8 @@ class HaloExchange:
                 "ppermute method for shard_map composition)"
             )
         if self.method == Method.DIRECT26:
-            assert axes is None, "axis subsetting requires AXIS_COMPOSED"
+            if axes is not None:
+                raise ValueError("axis subsetting requires AXIS_COMPOSED")
             return self._direct26_blocks(block)
         return self._composed_blocks(block, axes)
 
@@ -249,12 +250,15 @@ class HaloExchange:
         from these buffers — the reference's pack-to-buffer transport
         economics (src/pack_kernel.cu:3-54) re-expressed: dense side
         buffers instead of strided inline halo writes."""
-        assert self.spec.radius.x(-1) == 0 and self.spec.radius.x(1) == 0, (
-            "x_side_buffers is the tight-x (zero x radius) transport"
-        )
+        if self.spec.radius.x(-1) != 0 or self.spec.radius.x(1) != 0:
+            raise ValueError(
+                "x_side_buffers is the tight-x (zero x radius) transport"
+            )
         sizes = self.spec.sizes_x
-        assert len(set(sizes)) == 1, "side buffers require a uniform x split"
-        assert self.resident.x == 1, "side buffers do not support x residency"
+        if len(set(sizes)) != 1:
+            raise ValueError("side buffers require a uniform x split")
+        if self.resident.x != 1:
+            raise ValueError("side buffers do not support x residency")
         n = len(sizes)
         nx = sizes[0]
         hi_cols = block[..., nx - r : nx]
@@ -1013,7 +1017,11 @@ def shard_blocks(
     exchange, like fresh cudaMalloc in local_domain.cu:159-220).
     """
     g = spec.global_size
-    assert global_zyx.shape == (g.z, g.y, g.x), (global_zyx.shape, g)
+    if global_zyx.shape != (g.z, g.y, g.x):
+        raise ValueError(
+            f"global array shape {global_zyx.shape} != grid "
+            f"({g.z}, {g.y}, {g.x})"
+        )
     stacked = np.zeros(spec.stacked_shape_zyx(), dtype=dtype or global_zyx.dtype)
     off = spec.compute_offset()
     for iz in range(spec.dim.z):

@@ -60,7 +60,11 @@ def solve(
     w = np.ascontiguousarray(w, dtype=np.float64)
     d = np.ascontiguousarray(d, dtype=np.float64)
     n = w.shape[0]
-    assert w.shape == (n, n) and d.shape == (n, n)
+    if w.shape != (n, n) or d.shape != (n, n):
+        raise ValueError(
+            f"weight/distance matrices must both be ({n}, {n}); got "
+            f"{w.shape} and {d.shape}"
+        )
     if use_native:
         native = _native()
         if native is not None:
@@ -91,7 +95,11 @@ def solve_catch(
     w = np.ascontiguousarray(w, dtype=np.float64)
     d = np.ascontiguousarray(d, dtype=np.float64)
     n = w.shape[0]
-    assert w.shape == (n, n) and d.shape == (n, n)
+    if w.shape != (n, n) or d.shape != (n, n):
+        raise ValueError(
+            f"weight/distance matrices must both be ({n}, {n}); got "
+            f"{w.shape} and {d.shape}"
+        )
     if use_native:
         native = _native()
         if native is not None:

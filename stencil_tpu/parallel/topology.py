@@ -33,5 +33,7 @@ class Topology:
     def get_neighbor(self, index, direction) -> Neighbor:
         idx = Dim3.of(index)
         d = Dim3.of(direction)
-        assert abs(d.x) <= 1 and abs(d.y) <= 1 and abs(d.z) <= 1
+        if not (abs(d.x) <= 1 and abs(d.y) <= 1 and abs(d.z) <= 1):
+            raise ValueError(f"direction components must be in "
+                             f"{{-1, 0, 1}}; got {d}")
         return Neighbor(index=(idx + d).wrap(self.extent), exists=True)

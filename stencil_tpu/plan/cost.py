@@ -135,7 +135,7 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
     radius = scale_radius(config.radius_obj(), choice.multistep_k)
     try:
         spec = GridSpec(g, dim, radius)
-    except AssertionError:
+    except (AssertionError, ValueError):
         return None
     c = nb // config.ndev
     if c == 1:

@@ -55,7 +55,9 @@ def make_entry(config: PlanConfig, choice: PlanChoice, source: str,
                measured_s: Optional[float] = None,
                probes: Optional[list] = None,
                note: Optional[str] = None) -> dict:
-    assert source in SOURCES, source
+    if source not in SOURCES:
+        raise ValueError(f"unknown plan source {source!r} "
+                         f"(known: {', '.join(SOURCES)})")
     return {
         "config": config.to_json(),
         "choice": choice.to_json(),
