@@ -229,6 +229,55 @@ def _child_main(mode: str, resume: bool = False) -> int:
         except Exception as e:
             errors["exchange_remote_dma"] = f"{type(e).__name__}: {e}"[:400]
 
+    # fused compute+exchange jacobi (ROADMAP #5): the fused REMOTE_DMA
+    # step — interior compute overlapping the kernel-initiated copies —
+    # vs the serialized remote-dma step (exchange dispatch then sweep)
+    # at 128^3 on the 8-device mesh. CPU-emulation caveat, exactly like
+    # exchange_remote_dma_over_composed above: on the CPU child both
+    # legs run the host-orchestrated schedule, so the ratio there prices
+    # host orchestration, not ICI overlap — only the TPU mega-kernel
+    # number carries the ROADMAP-5 claim. Ledger ingest auto-appends
+    # every numeric key below via STENCIL_BENCH_LEDGER.
+    jac_fused_mc = 0.0
+    jac_rd_mc = 0.0
+    if leg("jacobi fused-over-remote-dma (128^3, 8-dev)"):
+        try:
+            import jax.numpy as jnp
+
+            from stencil_tpu.ops.jacobi import (INIT_TEMP, make_jacobi_loop,
+                                                sphere_sel)
+
+            nbf = min(n, 128)
+            ndevf = 8 if len(jax.devices()) >= 8 else 1
+            dimf = Dim3(2, 2, 2) if ndevf == 8 else Dim3(1, 1, 1)
+            specf = GridSpec(Dim3(nbf, nbf, nbf), dimf, Radius.constant(1))
+            meshf = grid_mesh(specf.dim, jax.devices()[:ndevf])
+            self_ = shard_blocks(sphere_sel((nbf, nbf, nbf)), specf, meshf)
+            field0 = shard_blocks(
+                np.full((nbf,) * 3, INIT_TEMP, np.float32), specf, meshf)
+
+            def jac_leg(fused: bool) -> float:
+                ex = HaloExchange(specf, meshf, Method.REMOTE_DMA,
+                                  fused=fused)
+                sub_iters = 3
+                loop = make_jacobi_loop(ex, sub_iters)
+                c = field0
+                nx_ = jax.device_put(jnp.zeros_like(c), ex.sharding())
+                c, nx_ = loop(c, nx_, self_)  # compile + warm
+                hard_sync((c, nx_))
+                st = Statistics()
+                for _ in range(2):
+                    t1 = time.perf_counter()
+                    c, nx_ = loop(c, nx_, self_)
+                    hard_sync((c, nx_))
+                    st.insert((time.perf_counter() - t1) / sub_iters)
+                return nbf ** 3 / st.trimean() / 1e6
+
+            jac_fused_mc = jac_leg(True)
+            jac_rd_mc = jac_leg(False)
+        except Exception as e:
+            errors["jacobi_fused"] = f"{type(e).__name__}: {e}"[:400]
+
     # quantity-batching A/B at Q=8 (the astaroth field count): one packed
     # ppermute carrier per axis phase vs one collective per quantity. On an
     # 8-device mesh (the CPU child forces 8 virtual devices) the partition
@@ -404,6 +453,17 @@ def _child_main(mode: str, resume: bool = False) -> int:
         "exchange_remote_dma_over_composed": (
             round(ex_rd_gb_s / ex_rd_base_gb_s, 3)
             if ex_rd_base_gb_s else 0.0
+        ),
+        # fused compute+exchange step over the serialized remote-dma
+        # step, 128^3 / 8-dev (> 1 means hiding the wire behind interior
+        # compute won; on the CPU child both legs are the
+        # host-orchestrated emulation — the ratio there prices host
+        # orchestration, and only the TPU mega-kernel number carries the
+        # ROADMAP-5 overlap claim)
+        "jacobi_fused_mcells_per_s": round(jac_fused_mc, 2),
+        "jacobi_remote_dma_mcells_per_s": round(jac_rd_mc, 2),
+        "jacobi_fused_over_remote_dma": (
+            round(jac_fused_mc / jac_rd_mc, 3) if jac_rd_mc else 0.0
         ),
         # quantity-batching leg (Q=8, the astaroth field count): batched
         # packed-carrier exchange over the per-quantity program

@@ -1,28 +1,33 @@
-"""On-chip probe: Method.REMOTE_DMA carrier kernels vs ppermute methods.
+"""On-chip probe: the 5-way exchange A/B — composed / auto-spmd /
+direct26 / remote-dma / FUSED — plus the wire-compression tiers.
 
-The ISSUE-10 hardware half (ROADMAP #2 -> #1): the kernel-initiated
-exchange (ops/remote_dma.py — per-neighbor ``pltpu.make_async_remote_copy``
-from inside the carrier kernel, 0 collective-permutes in the compiled
-program) exists and is parity-pinned on the CPU emulation, but the claim
-it was built for — per-collective DISPATCH overhead, not bytes, dominates
-this stack (round-7/10 censuses), so bypassing the XLA collective path
-should beat the composed ppermute transport — needs real ICI. This probe
-is the decisive A/B, staged for a multi-chip TPU session:
+The ISSUE-10 hardware half grown by ISSUE 14 (ROADMAP #5 -> #1): the
+kernel-initiated exchange (ops/remote_dma.py) and its FUSED
+compute+exchange variant (ops/fused_stencil.py — every per-direction
+copy started boundary-first so interior compute hides the wire) are
+parity-pinned on the CPU emulation, but the claims they were built
+for — per-collective DISPATCH overhead dominates (rounds 7/10), and
+wire time can hide behind interior FLOPs — need real ICI. This probe is
+the decisive A/B, staged for ONE multi-chip TPU session:
 
-1. composed / direct26 / auto-spmd / remote-dma back-to-back at the probe
-   config (radius 2, 4 fp32 quantities, one block per chip), trimean
-   ms/exchange + GB/s logical, with the 0-ppermute census verified on the
-   compiled remote program;
-2. the same remote-dma leg with ``--wire-dtype bfloat16``: on TPU the
-   carrier really ships bf16 (no CPU float-normalization widening), so
-   this measures what halving the wire bytes buys on real links;
-3. numbers feed ``plan/cost.py DEFAULT_CALIBRATION["remote_dma"]``
-   (provenance flips modeled -> measured) and the plan DB via
+1. composed / direct26 / auto-spmd / remote-dma / fused back-to-back at
+   the probe config (radius 2, 4 fp32 quantities, one block per chip),
+   trimean ms/exchange + GB/s logical, with the 0-ppermute census
+   verified on both kernel-initiated programs;
+2. wire-compression rows: remote-dma and fused under
+   ``wire_dtype=bfloat16`` (2x bytes) and the fp8 tier
+   ``float8_e4m3fn`` (4x bytes) — on TPU the carriers really ship the
+   narrow dtype, so this measures what the byte reduction buys on real
+   links at each overlap level;
+3. numbers feed ``plan/cost.py DEFAULT_CALIBRATION`` ("remote_dma" and
+   "fused" provenance flip modeled -> measured) and the plan DB via
    ``plan_tool autotune`` (item-1 recalibration session).
 
 Needs >= 2 TPU chips (a single chip self-wraps every phase and issues no
 remote DMA). Exits early with one line when no TPU is present;
-``--cpu-smoke`` runs a tiny emulation pass instead (the CI-covered path).
+``--cpu-smoke`` runs the full 5-way + wire rows against the emulation at
+a tiny size instead (the CI-covered path; ratios there are correctness
+vehicles, not claims).
 
 Usage: python scripts/probe_remote_dma.py [n] [chunk]
        python scripts/probe_remote_dma.py --cpu-smoke
@@ -79,8 +84,9 @@ mesh = grid_mesh(part, jax.devices()[:ndev])
 NQ = 4
 
 
-def leg(method, wire_dtype=None):
-    ex = HaloExchange(spec, mesh, method, wire_dtype=wire_dtype)
+def leg(method, wire_dtype=None, fused=False):
+    ex = HaloExchange(spec, mesh, method, wire_dtype=wire_dtype,
+                      fused=fused)
     loop = ex.make_loop(chunk)
     state = {
         i: shard_blocks(np.zeros((n, n, n), np.float32), spec, mesh)
@@ -101,22 +107,29 @@ def leg(method, wire_dtype=None):
          for i in range(NQ)})
     cp = census.get("collective-permute", (0, 0))
     gb = ex.bytes_logical([4] * NQ) / st.trimean() / 1e9
-    tag = method.value + (f"+wire={wire_dtype}" if wire_dtype else "")
-    print(f"{tag:34s} {st.trimean()*1e3:9.3f} ms/exchange  {gb:7.2f} GB/s  "
+    tag = (method.value + ("+fused" if fused else "")
+           + (f"+wire={wire_dtype}" if wire_dtype else ""))
+    print(f"{tag:40s} {st.trimean()*1e3:9.3f} ms/exchange  {gb:7.2f} GB/s  "
           f"permutes={cp[0]:3d} cp_bytes={cp[1]}  (compile {build_s:.0f}s)",
           flush=True)
     return st.trimean(), cp
 
 
-print(f"remote-dma probe: {n}^3, partition {part}, {ndev} devices, r2, "
-      f"{NQ} fp32 quantities, chunk {chunk}", flush=True)
+print(f"remote-dma/fused probe: {n}^3, partition {part}, {ndev} devices, "
+      f"r2, {NQ} fp32 quantities, chunk {chunk}", flush=True)
+# the 5-way A/B: every transport at the same config
 t_comp, _ = leg(Method.AXIS_COMPOSED)
-if not cpu_smoke:
-    leg(Method.DIRECT26)
-    leg(Method.AUTO_SPMD)
+leg(Method.DIRECT26)
+leg(Method.AUTO_SPMD)
 t_rd, cp_rd = leg(Method.REMOTE_DMA)
 assert cp_rd[0] == 0, f"REMOTE_DMA census shows {cp_rd[0]} ppermutes"
-leg(Method.REMOTE_DMA, wire_dtype="bfloat16")
-kind = ("TPU carrier kernel" if not cpu_smoke
-        else "CPU emulation — correctness vehicle, ratio not a claim")
+t_fu, cp_fu = leg(Method.REMOTE_DMA, fused=True)
+assert cp_fu[0] == 0, f"FUSED census shows {cp_fu[0]} ppermutes"
+# wire tiers on both kernel-initiated transports: bf16 (2x) + fp8 (4x)
+for wd in ("bfloat16", "float8_e4m3fn"):
+    leg(Method.REMOTE_DMA, wire_dtype=wd)
+    leg(Method.REMOTE_DMA, wire_dtype=wd, fused=True)
+kind = ("TPU carrier kernels" if not cpu_smoke
+        else "CPU emulation — correctness vehicle, ratios not claims")
 print(f"remote_dma_over_composed: {t_comp / t_rd:.3f}x ({kind})", flush=True)
+print(f"fused_over_remote_dma:    {t_rd / t_fu:.3f}x ({kind})", flush=True)

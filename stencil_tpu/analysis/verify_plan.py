@@ -81,6 +81,13 @@ class Verdict:
         }
 
 
+# The fused compute+exchange variant audits as a fifth "method" label:
+# method remote-dma with kernel_variant=fused (its lowering — the
+# concurrent per-direction transport — has its own census/byte/DMA
+# predictions to conform to).
+FUSED_METHOD_LABEL = "remote-dma+fused"
+
+
 def sweep_configs(
     size: int = DEFAULT_SIZE,
     radius: int = DEFAULT_RADIUS,
@@ -89,14 +96,16 @@ def sweep_configs(
     qsets: Sequence[Sequence[str]] = DEFAULT_QSETS,
 ) -> List[dict]:
     """The sweep grid as plain dicts (label, size, radius, partition,
-    method, dtypes)."""
+    method, dtypes). Default methods: every ``plan.ir.METHODS`` entry
+    PLUS the fused variant label ``remote-dma+fused``."""
     from ..plan.ir import METHODS
 
-    methods = list(methods or METHODS)
-    unknown = sorted(set(methods) - set(METHODS))
+    known = tuple(METHODS) + (FUSED_METHOD_LABEL,)
+    methods = list(methods or known)
+    unknown = sorted(set(methods) - set(known))
     if unknown:
         raise ValueError(f"unknown method(s): {', '.join(unknown)} "
-                         f"(known: {', '.join(METHODS)})")
+                         f"(known: {', '.join(known)})")
     out = []
     for part in partitions:
         for dtypes in qsets:
@@ -138,10 +147,12 @@ def audit_config(cfg: dict, devices=None,
     from ..parallel import HaloExchange, Method, grid_mesh
     from ..parallel.exchange import shard_blocks
     from ..plan.cost import feasible
-    from ..plan.ir import PlanChoice, PlanConfig, REMOTE_DMA
+    from ..plan.ir import FUSED_VARIANT, PlanChoice, PlanConfig, REMOTE_DMA
 
     devices = list(devices) if devices is not None else jax.devices()
     v = Verdict(label=cfg["label"], method=cfg["method"])
+    fused = cfg["method"] == FUSED_METHOD_LABEL
+    method = REMOTE_DMA if fused else cfg["method"]
     size, dtypes = cfg["size"], list(cfg["dtypes"])
     import numpy as np
 
@@ -157,7 +168,8 @@ def audit_config(cfg: dict, devices=None,
         return v
     config = PlanConfig.make(Dim3(size, size, size), radius, dtypes,
                              nblocks, devices[0].platform)
-    choice = PlanChoice(partition=cfg["partition"], method=cfg["method"])
+    choice = PlanChoice(partition=cfg["partition"], method=method,
+                        kernel_variant=FUSED_VARIANT if fused else None)
     feas = feasible(config, choice)
     if feas is None:
         v.skipped = True
@@ -168,7 +180,7 @@ def audit_config(cfg: dict, devices=None,
         return v
     spec, mesh_dim, _resident = feas
     mesh = grid_mesh(spec.dim, devices[:nblocks])
-    ex = HaloExchange(spec, mesh, Method(cfg["method"]))
+    ex = HaloExchange(spec, mesh, Method(method), fused=fused)
     g = spec.global_size
     base = np.arange(g.x * g.y * g.z, dtype=np.float64).reshape(
         g.z, g.y, g.x)
@@ -196,10 +208,11 @@ def audit_config(cfg: dict, devices=None,
     ok = _check(v.checks, "collectives_per_exchange",
                 predicted_coll, actual_coll)
     ok &= _check(v.checks, "stray_collective_kinds", {}, stray)
-    if cfg["method"] == REMOTE_DMA:
-        # the transport bypasses XLA collectives entirely: the census
-        # must carry ZERO bytes, and the wire prediction is cross-checked
-        # through the emulated per-neighbor transfer count instead
+    if method == REMOTE_DMA:
+        # the transport bypasses XLA collectives entirely (fused
+        # variant included): the census must carry ZERO bytes, and the
+        # wire prediction is cross-checked through the emulated
+        # per-neighbor transfer count instead
         ok &= _check(v.checks, "census_bytes", 0, actual_bytes)
         ex(state)  # one real (emulated) exchange counts its transfers
         actual_transfers = ex._remote.last_transfer_count

@@ -66,6 +66,7 @@ class DistributedDomain:
         self._dtypes: List[str] = []
         self._method = Method.AXIS_COMPOSED
         self._batch_quantities = True
+        self._fused = False
         self._wire_dtype: Optional[str] = None
         self._devices: Optional[Sequence] = None
         self._partition_dim: Optional[Dim3] = None
@@ -148,6 +149,18 @@ class DistributedDomain:
         """The effective tuned choice (None on a plan-less domain)."""
         return self._plan_choice
 
+    def set_fused_exchange(self, enabled: bool) -> None:
+        """The FUSED compute+exchange variant of ``Method.REMOTE_DMA``
+        (ROADMAP #5): the exchange moves one exact-extent message per
+        active direction, all started boundary-first so the step loops
+        overlap interior compute behind the wire (the Pallas mega-kernel
+        on TPU, the host-orchestrated schedule elsewhere — both zero
+        collective-permutes). Applied at realize(); also set
+        automatically when a tuned plan carries
+        ``kernel_variant == "fused"``. Single-resident partitions only —
+        realize() raises loudly otherwise."""
+        self._fused = bool(enabled)
+
     def set_quantity_batching(self, enabled: bool) -> None:
         """Quantity-batched exchange (default on): per collective, all
         same-dtype quantities' boundary slabs ride ONE packed ``(Q, ...)``
@@ -227,6 +240,14 @@ class DistributedDomain:
                 else:
                     self._method = Method(ch.method)
                     self._batch_quantities = ch.batch_quantities
+                    # the tuned choice owns the variant BOTH ways: a
+                    # fused choice realizes the fused transport, and a
+                    # non-fused choice clears any prior
+                    # set_fused_exchange(True) — the autotune -> DB ->
+                    # zero-probe replay round-trip must reproduce the
+                    # tuned program exactly (and a composed winner must
+                    # not crash realize() on a stale fused flag)
+                    self._fused = ch.is_fused
                     if self._partition_dim is None:
                         self._partition_dim = Dim3.of(ch.partition)
             if self._partition_dim is not None:
@@ -274,6 +295,7 @@ class DistributedDomain:
                 self.spec, self.mesh, self._method,
                 batch_quantities=self._batch_quantities,
                 wire_dtype=self._wire_dtype,
+                fused=self._fused,
             )
             sharding = self._exchange.sharding()
             for idx, dt in enumerate(self._dtypes):
@@ -425,13 +447,16 @@ class DistributedDomain:
         devs = self.mesh.devices.flatten()
         cfg = PlanConfig.make(self.size, self.radius, self._dtypes,
                               len(devs), devs[0].platform)
+        from .plan.ir import FUSED_VARIANT
+
         ch = self._plan_choice
         choice = PlanChoice(
             partition=(self.spec.dim.x, self.spec.dim.y, self.spec.dim.z),
             method=self._method.value,
             batch_quantities=self._batch_quantities,
             multistep_k=ch.multistep_k if ch is not None else 1,
-            kernel_variant=ch.kernel_variant if ch is not None else None,
+            kernel_variant=(ch.kernel_variant if ch is not None
+                            else FUSED_VARIANT if self._fused else None),
         )
         return {"key": cfg.to_json(), "choice": choice.to_json(),
                 "tuned": ch is not None,

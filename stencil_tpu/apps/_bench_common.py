@@ -223,6 +223,7 @@ def time_exchange(
     batch_quantities: bool = True,
     partition=None,
     wire_dtype=None,
+    fused: bool = False,
 ) -> dict:
     """Realize a domain with ``quantities`` quantities and time ``iters``
     exchanges in fused chunks. Returns stats + the domain.
@@ -231,12 +232,17 @@ def time_exchange(
     one-collective-per-quantity program (the ``--batched-ab`` baseline);
     ``partition`` forces the block grid (e.g. ``(2, 2, 2)``) so A/B runs
     pin the mesh instead of trusting the auto-partitioner; ``wire_dtype``
-    turns on the (lossy) bf16-on-the-wire carrier compression."""
+    turns on the (lossy) bf16/fp8-on-the-wire carrier compression;
+    ``fused`` times the fused compute+exchange variant's concurrent
+    per-direction transport (REMOTE_DMA only — the autotuner's fused
+    candidates probe through here)."""
     devices = list(devices) if devices is not None else jax.devices()
     dd = DistributedDomain(size.x, size.y, size.z)
     dd.set_radius(radius)
     dd.set_methods(method)
     dd.set_quantity_batching(batch_quantities)
+    if fused:
+        dd.set_fused_exchange(True)
     if wire_dtype:
         dd.set_wire_dtype(wire_dtype)
     if partition is not None:
@@ -259,8 +265,11 @@ def time_exchange(
     if tail:
         loops[tail] = dd.halo_exchange.make_loop(tail)
     # the wire tag keeps a --wire-ab run's legs separable in aggregation
-    # (report._agg_key splits on it, like method/batched)
+    # (report._agg_key splits on it, like method/batched); the variant
+    # tag does the same for the fused A/B legs
     wtag = {"wire": str(wire_dtype)} if wire_dtype else {}
+    if fused:
+        wtag["variant"] = "fused"
     # compile + warm every loop size OUTSIDE the timed region
     with rec.span("exchange.warmup", phase="compile", method=method.value,
                   batched=batch_quantities, **wtag):

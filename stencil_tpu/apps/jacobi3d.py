@@ -79,6 +79,7 @@ def run(
     rollback_backoff: float = 0.25,
     inject: Optional[str] = None,
     wire_dtype: Optional[str] = None,
+    fused: bool = False,
     sentinel=None,
     status=None,
 ) -> dict:
@@ -132,6 +133,10 @@ def run(
         dd.set_radius(deep_halo)
     dd.set_methods(method)
     dd.set_devices(devices)
+    if fused:
+        # the fused compute+exchange variant (REMOTE_DMA only —
+        # DistributedDomain validates loudly at realize())
+        dd.set_fused_exchange(True)
     if wire_dtype:
         dd.set_wire_dtype(wire_dtype)
     if partition is not None:
@@ -483,10 +488,17 @@ def main(argv: Optional[list] = None) -> int:
                    help="on-disk plan DB (JSON) for --autotune; also "
                         "inspectable via apps/plan_tool.py")
     p.add_argument("--wire-dtype", type=str, default="",
-                   help="bf16-on-the-wire halo compression: wire-crossing "
+                   help="on-the-wire halo compression (bfloat16 or the fp8 "
+                        "tier float8_e4m3fn): wire-crossing "
                         "exchange carriers narrow to this dtype (LOSSY — "
                         "halos round to the wire precision; "
                         "bench_exchange --wire-ab measures the error)")
+    p.add_argument("--fused", action="store_true",
+                   help="the fused compute+exchange variant of "
+                        "--method remote-dma: every per-direction copy "
+                        "starts boundary-first and interior compute hides "
+                        "the wire (ops/fused_stencil.py; "
+                        "fused.overlap_fraction in the metrics)")
     p.add_argument("--prefix", type=str, default="")
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
     p.add_argument("--deep-halo", type=int, default=1,
@@ -555,6 +567,7 @@ def main(argv: Optional[list] = None) -> int:
             rollback_backoff=args.rollback_backoff,
             inject=args.inject or None,
             wire_dtype=args.wire_dtype or None,
+            fused=args.fused,
             sentinel=sentinel,
             status=status,
         )

@@ -193,13 +193,36 @@ def cmd_autotune(args) -> int:
     for m in methods:
         if m not in METHODS:
             raise SystemExit(f"unknown method {m!r} (choose from {METHODS})")
+    # kernel variants to search (e.g. --variants fused pins the search
+    # to the fused compute+exchange candidates, --variants none to the
+    # unvariant programs only); default: the unvariant program plus,
+    # for remote-dma, the fused variant (cost.enumerate_candidates adds
+    # it). Validated like --methods — a typo'd variant must fail here,
+    # not land in the DB as a string no lowering recognizes.
+    from ..plan.cost import DEFAULT_VARIANTS
+    from ..plan.ir import FUSED_VARIANT
+
+    if args.variants:
+        variants = []
+        for t in (s.strip() for s in args.variants.split(",") if s.strip()):
+            if t == "none":
+                variants.append(None)
+            elif t == FUSED_VARIANT:
+                variants.append(FUSED_VARIANT)
+            else:
+                raise SystemExit(
+                    f"unknown kernel variant {t!r} (choose from "
+                    f"'{FUSED_VARIANT}', 'none')")
+        variants = tuple(variants)
+    else:
+        variants = DEFAULT_VARIANTS
     res = autotune(
         Dim3(args.x, args.y, args.z), Radius.constant(args.radius),
         [args.dtype] * args.quantities,
         devices=jax.devices()[: args.ndev] if args.ndev else None,
         db_path=args.db or None, top_n=args.top_n,
         probe_iters=args.probe_iters, probe=not args.no_probe,
-        force=args.force, methods=methods,
+        force=args.force, methods=methods, variants=variants,
     )
     print(f"chosen: {res.choice.label()}")
     print(f"source: {res.source}  cache_hit: {res.cache_hit}  "
@@ -264,6 +287,12 @@ def main(argv: Optional[list] = None) -> int:
                     help="comma list restricting the searched exchange "
                          "methods (e.g. 'remote-dma' to tune/persist a "
                          "remote-dma-keyed entry); default: all")
+    sp.add_argument("--variants", default="",
+                    help="comma list restricting the searched kernel "
+                         "variants: 'fused' (the fused compute+exchange "
+                         "variant) and/or 'none' (the unvariant "
+                         "programs); default: the unvariant program + "
+                         "remote-dma's fused variant")
     _add_config_flags(sp)
     from ._bench_common import add_metrics_flags
 

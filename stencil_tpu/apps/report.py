@@ -81,11 +81,15 @@ def _agg_key(rec: dict) -> str:
     and averaging them would read as neither. ``mode`` is the campaign
     A/B's tag (``campaign.step_latency_s`` carries batched AND sequential
     samples in one ab run — a folded p99 would describe neither leg)."""
-    # ``wire`` splits the bf16-on-the-wire A/B (bench_exchange --wire-ab):
-    # the compressed and native legs' timings/census differ by design
+    # ``wire`` splits the bf16/fp8-on-the-wire A/B (bench_exchange
+    # --wire-ab): the compressed and native legs' timings/census differ
+    # by design. ``variant`` splits the kernel-variant legs the same way
+    # (the fused compute+exchange A/B: a fused.overlap_fraction or
+    # exchange.trimean_s folded across variants would describe neither)
     name = rec["name"]
     tags = [str(rec[t])
-            for t in ("method", "batched", "mode", "wire") if t in rec]
+            for t in ("method", "batched", "mode", "wire", "variant")
+            if t in rec]
     if tags:
         return f"{name}[{','.join(tags)}]"
     return name

@@ -87,6 +87,17 @@ NAME_FIELDS = {
     "anomaly.cleared": (("metric", str), ("step", int)),
     "slo.violation": (("tenant", str), ("step", int)),
     "replan.requested": (("reason", str), ("step", int)),
+    # the fused compute+exchange vocabulary (ops/fused_stencil +
+    # the host-orchestrated fused loops in ops/jacobi /
+    # astaroth/integrate): the overlap split of one fused substep —
+    # pack+start, interior compute (the hiding window), the recv-
+    # semaphore wait, boundary compute — variant-tagged spans so the
+    # PR-12 live sentinel and the trace export see where wire time
+    # goes; no extra required fields beyond the span schema
+    "fused.pack": (),
+    "fused.interior": (),
+    "fused.dma_wait": (),
+    "fused.boundary": (),
     # the static-analysis vocabulary (stencil_tpu/analysis/): per-config
     # plan-auditor verdicts, the audit summaries the CI static gate
     # archives, and the lint summary — schema-gated like every other
@@ -126,6 +137,9 @@ KNOWN_NAMES = frozenset(NAME_FIELDS) | frozenset({
     "exchange.bytes_on_wire", "exchange.bytes_on_wire_per_quantity",
     "exchange.gb_per_s", "exchange.iter", "exchange.permutes_per_quantity",
     "exchange.trimean_s", "exchange.warmup",
+    # interior-compute time over total fused-substep time: how much of
+    # the wire the fused schedule actually hid (gauge, variant-tagged)
+    "fused.overlap_fraction",
     "hb",
     "jacobi.exchange", "jacobi.exchange_bytes", "jacobi.exchange_warmup",
     "jacobi.init", "jacobi.iter", "jacobi.iter_trimean_s",

@@ -317,6 +317,136 @@ def _compile_jacobi_auto(ex: HaloExchange, overlap: bool, iters,
     )
 
 
+def _compile_jacobi_fused(ex: HaloExchange, iters,
+                          temporal_k: Optional[int] = None,
+                          multistep_rows: Optional[int] = None,
+                          interpret: bool = False):
+    """The FUSED REMOTE_DMA iteration (ROADMAP #5): one substep =
+    pack boundary slabs → START every per-neighbor copy → interior
+    compute while the DMAs fly → wait → boundary compute.
+
+    On an all-TPU mesh with an aligned uniform spec, the whole substep
+    is ONE Pallas mega-kernel (ops/fused_stencil.make_fused_jacobi_kernel)
+    inside a shard_map'd ``fori_loop`` — wire time hides behind interior
+    FLOPs *inside* the kernel. Everywhere else (the CPU mesh, uneven
+    partitions) the SAME schedule runs host-orchestrated: the fused
+    emulation's start/wait/finish split
+    (parallel/remote_emu.FusedRemoteEmulation) brackets compiled
+    collective-free sweeps — the interior sweep dispatches while the
+    emulated copies fly, so the overlap is real wall-clock overlap, and
+    the step output is bit-identical to the AXIS_COMPOSED overlap step
+    (tests/test_fused_stencil.py pins it, wire compression included).
+
+    The host path narrates itself: ``fused.pack`` / ``fused.interior`` /
+    ``fused.dma_wait`` / ``fused.boundary`` spans (variant-tagged, so
+    report aggregation splits them per kernel variant) plus the
+    ``fused.overlap_fraction`` gauge — interior-compute time over total
+    substep time, the overlap split the PR-12 live sentinel and the
+    trace export see."""
+    spec = ex.spec
+    r = spec.radius
+    assert min(
+        r.x(-1), r.x(1), r.y(-1), r.y(1), r.z(-1), r.z(1)
+    ) >= 1, "jacobi needs face radius >= 1 on every side"
+    if temporal_k is not None or multistep_rows is not None:
+        from ..utils import logging as log
+
+        log.warn(
+            f"temporal_k={temporal_k} multistep_rows={multistep_rows} "
+            "ignored: the temporal multistep composes with in-step "
+            "ppermute exchanges; the FUSED path runs one fused "
+            "exchange+sweep substep per step"
+        )
+    off = spec.compute_offset()
+    compute = Rect3(off, off + spec.base)
+    interior = interior_region(compute, r)
+    exteriors = exterior_regions(compute, interior)
+    on_tpu = all(d.platform == "tpu" for d in ex.mesh.devices.flatten())
+
+    if on_tpu and spec.is_uniform() and spec.aligned and not interpret:
+        # the mega-kernel path: exchange+sweep in ONE pallas_call
+        from .fused_stencil import make_fused_jacobi_kernel
+
+        p = spec.padded()
+        kern = make_fused_jacobi_kernel(
+            spec, ex.plan, wire_dtype=ex.wire_dtype)
+
+        def body(curr, nxt, sel):
+            c2, out = kern(
+                curr.reshape(p.z, p.y, p.x),
+                nxt.reshape(p.z, p.y, p.x),
+                sel.reshape(p.z, p.y, p.x),
+            )
+            return out.reshape(curr.shape), c2.reshape(curr.shape)
+
+        def entry_fn(curr, nxt, sel):
+            if iters is None:
+                return body(curr, nxt, sel)
+            return lax.fori_loop(
+                0, iters, lambda _, cn: body(cn[0], cn[1], sel),
+                (curr, nxt))
+
+        fn = jax.shard_map(
+            entry_fn, mesh=ex.mesh,
+            in_specs=(BLOCK_PSPEC,) * 3,
+            out_specs=(BLOCK_PSPEC, BLOCK_PSPEC),
+        )
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    # host-orchestrated fused schedule: compiled collective-free sweeps
+    # slotted between the emulation's start/wait/finish
+    uniform = spec.is_uniform()
+
+    def interior_body(curr, nxt, sel):
+        masks = (sel == 1, sel == 2)
+        if uniform:
+            return jacobi_sweep(curr, nxt, interior, masks)
+        # uneven: full-region sweep on pre-exchange data (boundary
+        # cells re-swept from the exchanged state below)
+        return jacobi_sweep(curr, nxt, compute, masks)
+
+    def boundary_body(cur2, out, sel):
+        if uniform:
+            masks = (sel == 1, sel == 2)
+            for rect in exteriors:
+                out = jacobi_sweep(cur2, out, rect, masks)
+            return out
+        return _patch_shells_dyn(spec, cur2, out, sel,
+                                 multi_block_only=False)
+
+    interior_fn = jax.jit(jax.shard_map(
+        interior_body, mesh=ex.mesh,
+        in_specs=(BLOCK_PSPEC,) * 3, out_specs=BLOCK_PSPEC))
+    boundary_fn = jax.jit(jax.shard_map(
+        boundary_body, mesh=ex.mesh,
+        in_specs=(BLOCK_PSPEC,) * 3, out_specs=BLOCK_PSPEC))
+
+    def loop(curr, nxt, sel):
+        from ..obs import telemetry
+        from ..parallel.remote_emu import run_fused_substep
+
+        rec = telemetry.get()
+        emu = ex._fused_host_schedule
+        t_interior = 0.0
+        t_total = 0.0
+        for _ in range(iters or 1):
+            cur2, out, t_int, t_tot = run_fused_substep(
+                emu, curr,
+                interior=lambda: interior_fn(curr, nxt, sel),
+                boundary=lambda c2, o: boundary_fn(c2, o, sel),
+                rec=rec,
+            )
+            t_interior += t_int
+            t_total += t_tot
+            curr, nxt = out, cur2  # the reference double-buffer swap
+        if rec.enabled and t_total > 0:
+            rec.gauge("fused.overlap_fraction", t_interior / t_total,
+                      phase="exchange", variant="fused")
+        return curr, nxt
+
+    return loop
+
+
 def _compile_jacobi_remote(ex: HaloExchange, iters,
                            temporal_k: Optional[int] = None,
                            multistep_rows: Optional[int] = None):
@@ -377,6 +507,9 @@ def _compile_jacobi(ex: HaloExchange, overlap: bool, iters, use_pallas=None,
         return _compile_jacobi_auto(ex, overlap, iters, temporal_k,
                                     multistep_rows)
     if ex.method == Method.REMOTE_DMA:
+        if getattr(ex, "fused", False):
+            return _compile_jacobi_fused(ex, iters, temporal_k,
+                                         multistep_rows, interpret)
         return _compile_jacobi_remote(ex, iters, temporal_k, multistep_rows)
     assert min(r.y(-1), r.y(1), r.z(-1), r.z(1)) >= 1, (
         "jacobi needs face radius >= 1 on every side"

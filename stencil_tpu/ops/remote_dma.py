@@ -51,13 +51,18 @@ from ..ops.halo_fill import wire_narrow_dtype
 
 
 def remote_kernel_supported(spec, resident) -> bool:
-    """What the first-cut carrier kernel handles: uniform partitions,
-    one resident block per device (the flagship regime). Uneven and
-    oversubscribed REMOTE_DMA stay with the CPU emulation's geometry
-    until a hardware session extends the kernel."""
+    """What the carrier kernel handles: one resident block per device.
+    Uniform AND uneven (remainder) partitions are supported — on an
+    uneven ring the slab extents (rm/rp × full padded orthogonals) are
+    identical across participants and only the hi-side slab's start
+    offset varies, so the kernel reads it from the static per-ring size
+    table at its own ``axis_index`` (the same size-table discipline as
+    the dynamic overlap shells). Oversubscribed REMOTE_DMA stays with
+    the CPU emulation's geometry until a hardware session extends the
+    kernel — loud infeasibility, never a silent fallback."""
     from ..geometry import Dim3
 
-    return spec.is_uniform() and resident == Dim3(1, 1, 1)
+    return resident == Dim3(1, 1, 1)
 
 
 def make_remote_axis_kernel(spec, phase, nq: int, dtype,
@@ -76,7 +81,12 @@ def make_remote_axis_kernel(spec, phase, nq: int, dtype,
     p = spec.padded()
     pz, py, px = p.z, p.y, p.x
     rm, rp, off = phase.rm, phase.rp, phase.offset
+    # uneven rings share every slab EXTENT (rm/rp x full padded
+    # orthogonals); only the hi-side start offset depends on this
+    # device's block size, read from the static size table in-kernel
+    uniform = phase.uniform
     sz = phase.sizes[0]
+    sizes_tbl = phase.sizes  # static per-ring ints from the plan IR
     axis = phase.axis
     # slab shapes (z, y, x) with the phase axis narrowed to the radius
     def slab_shape(r):
@@ -105,6 +115,8 @@ def make_remote_axis_kernel(spec, phase, nq: int, dtype,
         m = phase.ring
         fwd = (my + 1) % m
         bwd = (my - 1 + m) % m
+        sz_my = (sz if uniform
+                 else jnp.asarray(sizes_tbl, jnp.int32)[my])
 
         def stage_in(src_ref, sl, dst_buf, stage, q):
             """HBM slab -> wire-dtype VMEM staging. A DMA cannot cast,
@@ -151,7 +163,7 @@ def make_remote_axis_kernel(spec, phase, nq: int, dtype,
         rdmas = []
         if rm:
             for q in range(nq):
-                stage_in(ins[q], dslice(off + sz - rm, rm), send_hi,
+                stage_in(ins[q], dslice(off + sz_my - rm, rm), send_hi,
                          stage_rm, q)
             rdma = pltpu.make_async_remote_copy(
                 src_ref=send_hi, dst_ref=comm_lo,
@@ -183,7 +195,7 @@ def make_remote_axis_kernel(spec, phase, nq: int, dtype,
         if rp:
             for q in range(nq):
                 stage_out(comm_hi, stage_rp, q, outs[q],
-                          dslice(off + sz, rp))
+                          dslice(off + sz_my, rp))
 
     block = jax.ShapeDtypeStruct((pz, py, px), dtype)
     return pl.pallas_call(
@@ -283,7 +295,10 @@ class RemoteDmaExchange:
                     kern = self._phase_kernel(rphase, len(keys), dt, cid)
                     shaped = [out[k].reshape(p.z, p.y, p.x) for k in keys]
                     res = kern(*shaped)
-                    res = (res,) if len(keys) == 1 else res
+                    # a tuple out_shape comes back as a tuple even at
+                    # length 1 — wrap only a bare array, never double-wrap
+                    if not isinstance(res, (tuple, list)):
+                        res = (res,)
                     blocks = [r.reshape(out[k].shape)
                               for r, k in zip(res, keys)]
                 for k, b in zip(keys, blocks):

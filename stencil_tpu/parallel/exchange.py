@@ -139,7 +139,8 @@ class HaloExchange:
     """
 
     def __init__(self, spec: GridSpec, mesh: Mesh, method: Method = Method.AXIS_COMPOSED,
-                 batch_quantities: bool = True, wire_dtype=None):
+                 batch_quantities: bool = True, wire_dtype=None,
+                 fused: bool = False):
         md = mesh_dim(mesh)
         # oversubscription (reference: dd.set_gpus({0,0}), stencil.hpp:154,
         # test_exchange.cu:52): more partition blocks than devices — the
@@ -170,6 +171,26 @@ class HaloExchange:
         self.mesh = mesh
         self.method = method
         self.batch_quantities = bool(batch_quantities)
+        # the fused compute+exchange variant (ROADMAP #5): still
+        # REMOTE_DMA — kernel-initiated copies, zero ppermutes — but the
+        # transport is the concurrent per-direction schedule the fused
+        # substep kernels overlap compute behind (plan.fused_phases;
+        # ops/fused_stencil.py on TPU, the host-orchestrated
+        # FusedRemoteEmulation elsewhere). Single-resident only, loudly.
+        self.fused = bool(fused)
+        if self.fused:
+            if method != Method.REMOTE_DMA:
+                raise ValueError(
+                    "fused=True is the REMOTE_DMA fused compute+exchange "
+                    f"variant; got method {method}"
+                )
+            if self.resident != Dim3(1, 1, 1):
+                raise ValueError(
+                    "the fused compute+exchange variant supports "
+                    "single-resident partitions only (got resident "
+                    f"{self.resident}); use plain REMOTE_DMA or "
+                    "AXIS_COMPOSED for oversubscription"
+                )
         # bf16-on-the-wire halo compression: wire-crossing packed
         # carriers narrow to this dtype before the send and widen on
         # unpack (ops/halo_fill.wire_narrow_dtype owns the policy: only
@@ -203,7 +224,7 @@ class HaloExchange:
         return build_plan(
             self.spec, mesh_dim(self.mesh), self.method,
             batch_quantities=self.batch_quantities, resident=self.resident,
-            wire_dtype=self.wire_dtype,
+            wire_dtype=self.wire_dtype, fused=self.fused,
         )
 
     # -- public API ----------------------------------------------------------
@@ -366,15 +387,48 @@ class HaloExchange:
         all-TPU mesh (ops/remote_dma.py — pltpu.make_async_remote_copy
         from inside the kernel), the semantics-exact host-orchestrated
         emulation everywhere else (parallel/remote_emu.py). Both are
-        callables over the state pytree; both compile ZERO collectives."""
+        callables over the state pytree; both compile ZERO collectives.
+        With ``fused`` the transport is the concurrent per-direction
+        schedule instead (ops/fused_stencil.FusedRemoteDmaExchange on
+        TPU; FusedRemoteEmulation off it) — same zero-collective pin,
+        plus the start/wait split the fused step loops overlap compute
+        behind."""
         assert self.method == Method.REMOTE_DMA
         if self._on_tpu():
+            if self.fused:
+                from ..ops.fused_stencil import FusedRemoteDmaExchange
+
+                return FusedRemoteDmaExchange(self)
             from ..ops.remote_dma import RemoteDmaExchange
 
             return RemoteDmaExchange(self)
+        if self.fused:
+            from .remote_emu import FusedRemoteEmulation
+
+            return FusedRemoteEmulation(self)
         from .remote_emu import RemoteDmaEmulation
 
         return RemoteDmaEmulation(self)
+
+    @cached_property
+    def _fused_host_schedule(self):
+        """The host-orchestrated start/wait/finish split of the fused
+        schedule — what the fused STEP loops bracket their compiled
+        sweeps with when the substep is not one mega-kernel. Off-TPU
+        this IS :attr:`_remote` (the FusedRemoteEmulation); on a TPU
+        mesh :attr:`_remote` is the carrier-kernel transport
+        (FusedRemoteDmaExchange — one kernel, no host-visible split),
+        so the loops get a separate host-orchestrated instance whose
+        ``device_put``s ride between the TPU devices. Requires
+        ``fused=True``."""
+        if not self.fused:
+            raise RuntimeError(
+                "_fused_host_schedule requires HaloExchange(fused=True)")
+        from .remote_emu import FusedRemoteEmulation
+
+        if not self._on_tpu():
+            return self._remote
+        return FusedRemoteEmulation(self)
 
     @cached_property
     def _compiled(self):

@@ -314,3 +314,240 @@ class RemoteDmaEmulation:
                 c0, b0 = total.get(kind, (0, 0))
                 total[kind] = (c0 + c, b0 + b)
         return total
+
+
+class FusedRemoteEmulation(RemoteDmaEmulation):
+    """Host-orchestrated FUSED compute+exchange schedule (ROADMAP #5).
+
+    The fused mega-kernel's order — (1) pack boundary slabs and START
+    every per-neighbor copy boundary-first, (2) compute interior tiles
+    while the DMAs fly, (3) wait the recv semaphores, (4) compute the
+    boundary tiles — executed host-side for non-TPU meshes, with the
+    caller owning steps 2 and 4 (``_compile_jacobi_fused`` /
+    ``make_fused_astaroth_loop`` slot their compiled collective-free
+    sweeps between :meth:`fused_start` and :meth:`fused_finish`).
+
+    The composed x→y→z slab geometry cannot start boundary-first (a y
+    slab carries x-halo data, so phase y's send depends on phase x's
+    receive); the fused schedule therefore moves one EXACT-extent
+    message per active direction — the plan's ``FusedPhaseIR`` records,
+    the DIRECT26 geometry re-transported. Every message reads only
+    sender compute-region cells, so all of them start concurrently, and
+    together they fill every declared halo cell bit-identically to
+    AXIS_COMPOSED (the same data-movement argument that pins DIRECT26;
+    tests/test_fused_stencil.py pins it here, wire compression
+    included — a carrier rounds exactly once either way). Every compiled
+    piece (per-device take/update programs) censuses ZERO
+    collective-permutes, the same pin as the serialized emulation."""
+
+    def __init__(self, ex):
+        from ..geometry import Dim3
+
+        super().__init__(ex)
+        if ex.resident != Dim3(1, 1, 1):
+            raise ValueError(
+                "the fused compute+exchange schedule supports "
+                "single-resident partitions only (got resident "
+                f"{ex.resident}); use the plain REMOTE_DMA carrier or "
+                "AXIS_COMPOSED for oversubscription"
+            )
+        if not self.plan.fused:
+            raise RuntimeError(
+                "fused emulation needs a fused plan (HaloExchange built "
+                "without fused=True?)"
+            )
+
+    # -- geometry -------------------------------------------------------------
+    def _block_sizes(self, coords) -> Tuple[int, int, int]:
+        iz, iy, ix = coords
+        s = self.ex.spec.block_size((ix, iy, iz))
+        return (s.z, s.y, s.x)
+
+    def _dir_slices(self, sizes, outbound: bool):
+        """Per-phase static (z, y, x) slices into a padded shard: the
+        outbound compute-region slab a device sends toward each
+        direction, or the halo region the received carrier fills —
+        exact extents, so no write overlaps another (no layering
+        needed). ``sizes`` are THIS device's block sizes (ring-sharing
+        makes the orthogonal extents match the sender's)."""
+        spec = self.ex.spec
+        r = spec.radius
+        off = spec.compute_offset()
+        out = []
+        for ph in self.plan.fused_phases:
+            dx, dy, dz = ph.direction
+            sl = [slice(None), slice(None), slice(None)]
+            for i, (dc, s, rmin, rplus, o) in enumerate(zip(
+                (dz, dy, dx), sizes,
+                (r.z(-1), r.y(-1), r.x(-1)),
+                (r.z(1), r.y(1), r.x(1)),
+                (off.z, off.y, off.x),
+            )):
+                if dc == 1:
+                    sl.append(slice(o + s - rmin, o + s) if outbound
+                              else slice(o - rmin, o))
+                elif dc == -1:
+                    sl.append(slice(o, o + rplus) if outbound
+                              else slice(o + s, o + s + rplus))
+                else:
+                    sl.append(slice(o, o + s))
+            out.append((tuple(sl), ph.crossing))
+        return out
+
+    def _fused_take_fn(self, sizes, shard_shape, dtype, nq, wire):
+        """take(*shards) -> one packed carrier per direction (phase
+        order), narrowed to the wire dtype on wire-crossing directions
+        (self-wrap hand-offs stay lossless — the composed policy)."""
+        specs = self._dir_slices(sizes, outbound=True)
+
+        def take(*shards):
+            out = []
+            for sl, crossing in specs:
+                car = pack_slabs([s[sl] for s in shards])
+                if wire is not None and crossing:
+                    car = car.astype(wire)
+                out.append(car)
+            return tuple(out)
+
+        return take
+
+    def _fused_update_fn(self, sizes, shard_shape, dtype, nq, wire):
+        """update(*shards, *carriers) -> new shards: widen + unpack every
+        received carrier into its exact halo region."""
+        specs = self._dir_slices(sizes, outbound=False)
+
+        def update(*args):
+            shards = list(args[:nq])
+            carriers = args[nq:]
+            for (sl, crossing), car in zip(specs, carriers):
+                if wire is not None and crossing:
+                    car = car.astype(dtype)
+                for q, slab in enumerate(unpack_slabs(car, nq)):
+                    shards[q] = shards[q].at[sl].set(slab)
+            return tuple(shards)
+
+        return update
+
+    # -- the fused schedule ---------------------------------------------------
+    def fused_start(self, state):
+        """Stages 1+2: pack every device's per-direction carriers
+        (compiled takes, zero collectives) and START the emulated remote
+        copies — ``device_put`` toward the neighbor, issued but not
+        synced, so the caller's interior compute dispatches while they
+        fly. Returns the pending structure for :meth:`fused_wait` /
+        :meth:`fused_finish`."""
+        leaves, treedef = jax.tree.flatten(state)
+        self.last_transfer_count = 0
+        mdevs = self.mesh.devices
+        mz, my, mx = mdevs.shape
+        phases = self.plan.fused_phases
+        pending = {"treedef": treedef, "leaves": leaves,
+                   "sharding": self.ex.sharding(), "groups": []}
+        for dtype, idxs in self._phase_groups(leaves):
+            nq = len(idxs)
+            wire = wire_narrow_dtype(dtype, self.ex.wire_dtype)
+            shards = [self._shards_by_coords(leaves[i]) for i in idxs]
+            coords_list = list(shards[0])
+            recv: Dict[Tuple[int, int, int], list] = {
+                c: [None] * len(phases) for c in coords_list}
+            for coords in coords_list:
+                sizes = self._block_sizes(coords)
+                args = tuple(s[coords] for s in shards)
+                key = ("ftake", sizes, args[0].shape, str(dtype), nq,
+                       str(wire))
+                fn = self._jit(key, lambda: self._fused_take_fn(
+                    sizes, args[0].shape, dtype, nq, wire))
+                self._remember(key, args)
+                carriers = fn(*args)
+                iz, iy, ix = coords
+                for pi, ph in enumerate(phases):
+                    dx, dy, dz = ph.direction
+                    dst = ((iz + dz) % mz, (iy + dy) % my, (ix + dx) % mx)
+                    car = carriers[pi]
+                    if dst != coords:
+                        car = jax.device_put(car, mdevs[dst])
+                        self.last_transfer_count += 1
+                    recv[dst][pi] = car
+            pending["groups"].append((dtype, idxs, shards, recv))
+        return pending
+
+    def fused_wait(self, pending) -> None:
+        """Stage 3: the recv-semaphore wait — block until every started
+        carrier has landed on its destination device."""
+        for _dt, _idxs, _shards, recv in pending["groups"]:
+            for per_dev in recv.values():
+                for car in per_dev:
+                    if car is not None:
+                        jax.block_until_ready(car)
+
+    def fused_finish(self, pending):
+        """Stage 4's data half: widen + unpack every received carrier
+        into the halos (compiled updates, zero collectives) and
+        reassemble the exchanged state; the caller's boundary compute
+        reads the result."""
+        leaves = list(pending["leaves"])
+        order = [self._coords[d.id] for d in self.mesh.devices.flat]
+        for dtype, idxs, shards, recv in pending["groups"]:
+            nq = len(idxs)
+            wire = wire_narrow_dtype(dtype, self.ex.wire_dtype)
+            new_shards: Dict[Tuple[int, int, int], tuple] = {}
+            for coords in recv:
+                sizes = self._block_sizes(coords)
+                args = tuple(s[coords] for s in shards)
+                carriers = tuple(recv[coords])
+                key = ("fupd", sizes, args[0].shape, str(dtype), nq,
+                       str(wire))
+                fn = self._jit(key, lambda: self._fused_update_fn(
+                    sizes, args[0].shape, dtype, nq, wire))
+                self._remember(key, args + carriers)
+                new_shards[coords] = fn(*args, *carriers)
+            for q, li in enumerate(idxs):
+                leaves[li] = jax.make_array_from_single_device_arrays(
+                    leaves[li].shape, pending["sharding"],
+                    [new_shards[c][q] for c in order],
+                )
+        return jax.tree.unflatten(pending["treedef"], leaves)
+
+    def _exchange_once(self, state):
+        """One standalone fused exchange (no compute slotted in): the
+        same pack → start → wait → update schedule, back to back."""
+        pending = self.fused_start(state)
+        self.fused_wait(pending)
+        return self.fused_finish(pending)
+
+
+def run_fused_substep(emu, state, interior, boundary, rec=None):
+    """One host-orchestrated fused substep — THE shared overlap
+    protocol of the fused step loops (ops/jacobi._compile_jacobi_fused,
+    astaroth/integrate.make_fused_astaroth_loop): start every emulated
+    copy, dispatch the caller's interior compute while they fly, wait,
+    unpack, then the caller's boundary compute, each stage under its
+    variant-tagged ``fused.*`` span so every fused loop reports the same
+    overlap semantics.
+
+    ``interior()`` returns the interior-computed output; ``boundary
+    (exchanged_state, out)`` returns the finished output. Both must be
+    collective-free compiled programs. Returns ``(exchanged_state, out,
+    interior_seconds, total_seconds)`` — the caller accumulates the two
+    times into its ``fused.overlap_fraction`` gauge."""
+    import time as _time
+
+    from ..obs import telemetry
+
+    rec = rec or telemetry.get()
+    t0 = _time.perf_counter()
+    with rec.span("fused.pack", phase="exchange", variant="fused"):
+        pending = emu.fused_start(state)
+    t1 = _time.perf_counter()
+    with rec.span("fused.interior", phase="compute", variant="fused"):
+        out = interior()
+        jax.block_until_ready(out)
+    t2 = _time.perf_counter()
+    with rec.span("fused.dma_wait", phase="exchange", variant="fused"):
+        emu.fused_wait(pending)
+    cur2 = emu.fused_finish(pending)
+    with rec.span("fused.boundary", phase="compute", variant="fused"):
+        out = boundary(cur2, out)
+        jax.block_until_ready(out)
+    t3 = _time.perf_counter()
+    return cur2, out, t2 - t1, t3 - t0

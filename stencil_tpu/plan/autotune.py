@@ -25,7 +25,7 @@ from typing import List, Optional, Sequence, Tuple
 from ..geometry import Dim3, Radius
 from ..utils import logging as log
 from . import db as plandb
-from .cost import enumerate_candidates, rank
+from .cost import DEFAULT_VARIANTS, enumerate_candidates, rank
 from .ir import METHODS, PlanChoice, PlanConfig
 
 
@@ -69,7 +69,7 @@ def autotune(
     force: bool = False,
     methods: Sequence[str] = METHODS,
     ks: Sequence[int] = (1,),
-    variants: Sequence[Optional[str]] = (None,),
+    variants: Sequence[Optional[str]] = DEFAULT_VARIANTS,
     calibration: Optional[dict] = None,
     rec=None,
 ) -> AutotuneResult:

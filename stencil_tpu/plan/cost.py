@@ -43,6 +43,7 @@ from .ir import (
     AUTO_SPMD,
     AXIS_COMPOSED,
     DIRECT26,
+    FUSED_VARIANT,
     METHODS,
     REMOTE_DMA,
     PlanChoice,
@@ -79,6 +80,19 @@ DEFAULT_CALIBRATION: Dict[str, object] = {
         "dma_overhead_s": 8.0e-5,
         "cpu_emulation_overhead_s": 4.0e-3,
         "wire_bytes_per_s": 3.9e8,
+        "provenance": "modeled, pending item-1 TPU recalibration",
+    },
+    # The fused compute+exchange mega-kernel (kernel_variant == "fused"
+    # on a REMOTE_DMA choice): the substep's wall-clock is
+    # max(interior_compute, dma) + boundary_compute — wire time hides
+    # behind interior FLOPs. Scored against candidates whose totals omit
+    # the (common) sweep compute, the fused EXCHANGE-attributable cost is
+    # that expression minus the full sweep: per-copy issue overhead plus
+    # only the UNHIDDEN wire time, max(0, dma - interior_compute).
+    # Provenance: MODELED, pending the item-1 TPU session — no silicon
+    # measurement of the overlap exists yet; probe_remote_dma.py's fused
+    # leg is the measurement that flips this to measured.
+    "fused": {
         "provenance": "modeled, pending item-1 TPU recalibration",
     },
 }
@@ -124,7 +138,19 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
     config, else None. Mirrors realize()'s constraints exactly: the
     partition's block count must be a multiple of ndev (residents stacked
     by the same z-heavy factorization), and no block may be thinner than
-    the effective radius."""
+    the effective radius. The fused compute+exchange variant is a
+    REMOTE_DMA-only, single-resident lowering — any other combination is
+    infeasible here (the loud-infeasibility contract: realize() raises
+    the same constraints)."""
+    if choice.kernel_variant == FUSED_VARIANT:
+        if choice.method != REMOTE_DMA:
+            return None
+        if choice.multistep_k != 1:
+            # the fused lowering runs ONE fused exchange per step and
+            # ignores temporal_k (ops/jacobi._compile_jacobi_fused warns
+            # and proceeds per-step) — scoring k>1 would amortize an
+            # exchange the realized program pays every step
+            return None
     dim = Dim3.of(choice.partition)
     g = Dim3.of(config.grid)
     if g.x < dim.x or g.y < dim.y or g.z < dim.z:
@@ -154,6 +180,8 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
             return None  # halo would span multiple blocks
     resident = Dim3(dim.x // mesh_dim.x, dim.y // mesh_dim.y,
                     dim.z // mesh_dim.z)
+    if choice.kernel_variant == FUSED_VARIANT and resident != Dim3(1, 1, 1):
+        return None  # the fused kernel is single-resident (build_plan raises)
     return spec, mesh_dim, resident
 
 
@@ -178,9 +206,10 @@ def score(config: PlanConfig, choice: PlanChoice,
     if feas is None:
         return None
     spec, mesh_dim, resident = feas
+    fused = choice.kernel_variant == FUSED_VARIANT
     plan = build_plan(spec, mesh_dim, choice.method,
                       batch_quantities=choice.batch_quantities,
-                      resident=resident)
+                      resident=resident, fused=fused)
     itemsizes = config.itemsizes()
     nq = config.num_quantities
     ngroups = config.dtype_group_count
@@ -188,7 +217,41 @@ def score(config: PlanConfig, choice: PlanChoice,
     wire = plan.wire_bytes(itemsizes, floating=config.floating_flags())
     local = plan.local_bytes(itemsizes)
     dmas = plan.dmas_per_exchange(nq, ngroups)
-    if choice.method == REMOTE_DMA:
+    if fused:
+        # overlap-aware: the fused substep runs
+        #   max(interior_compute, dma) + boundary_compute
+        # — wire time hides behind interior FLOPs. Candidates' totals
+        # omit the common full-sweep compute, so the fused cost charged
+        # here is that expression minus (interior + boundary): the
+        # per-copy issue overhead plus only the UNHIDDEN wire time.
+        # Per-copy overhead stays platform-split like plain REMOTE_DMA
+        # (the CPU schedule is host-orchestrated and must never win a
+        # cpu ranking on a TPU-modeled constant); provenance of all of
+        # it is cal["fused"]["provenance"] — MODELED until item 1's
+        # TPU session runs probe_remote_dma.py's fused leg.
+        rd = cal["remote_dma"]
+        per_dma = (rd["dma_overhead_s"] if config.platform == "tpu"
+                   else rd["cpu_emulation_overhead_s"])
+        wire_s = wire / rd.get("wire_bytes_per_s", cal["wire_bytes_per_s"])
+        b = spec.base
+        r0 = config.radius_obj()
+        shrink = [
+            (rm + rp) if n > 1 else 0
+            for n, rm, rp in (
+                (mesh_dim.x, r0.x(-1), r0.x(1)),
+                (mesh_dim.y, r0.y(-1), r0.y(1)),
+                (mesh_dim.z, r0.z(-1), r0.z(1)),
+            )
+        ]
+        interior_cells = (max(0, b.x - shrink[0]) * max(0, b.y - shrink[1])
+                          * max(0, b.z - shrink[2]))
+        interior_s = interior_cells * nq * cal["cell_update_s"]
+        exchange_s = (
+            dmas * per_dma
+            + max(0.0, wire_s - interior_s)
+            + local / cal["local_bytes_per_s"]
+        )
+    elif choice.method == REMOTE_DMA:
         # kernel-initiated copies: no ppermute dispatch at all; the
         # per-copy cost is platform-dependent (the CPU lowering is a
         # host-orchestrated emulation and must never win a cpu ranking
@@ -250,26 +313,45 @@ def candidate_partitions(config: PlanConfig,
     return out
 
 
+# The default kernel-variant set, as an identity-comparable sentinel:
+# enumerate_candidates() grows it with REMOTE_DMA's fused variant, while
+# any EXPLICITLY passed variant list — (None,) included — is honored
+# verbatim (plan_tool --variants none tunes plain remote-dma only).
+DEFAULT_VARIANTS: Tuple[Optional[str], ...] = (None,)
+
+
 def enumerate_candidates(
     config: PlanConfig,
     methods: Iterable[str] = METHODS,
     batch_options: Iterable[bool] = (True, False),
     ks: Iterable[int] = (1,),
-    variants: Iterable[Optional[str]] = (None,),
+    variants: Iterable[Optional[str]] = DEFAULT_VARIANTS,
     oversubscribe: Sequence[int] = (1,),
 ) -> List[PlanChoice]:
     """The search space: partition shape x method x quantity batching x
     temporal depth k x kernel variant. Batching only branches when the
     config has more than one quantity (at Q=1 the two programs are
-    identical — PR 5's degeneration contract)."""
+    identical — PR 5's degeneration contract). With the DEFAULT variant
+    set, REMOTE_DMA additionally branches on the fused compute+exchange
+    variant (kernel_variant == "fused") so the autotuner searches the
+    overlap lever out of the box; an EXPLICIT ``variants`` restriction —
+    ``(None,)`` included — is honored verbatim (the sentinel comparison
+    is by identity with :data:`DEFAULT_VARIANTS`). Infeasible fused
+    points (oversubscribed partitions) fall out at score() like every
+    other constraint."""
     if config.num_quantities <= 1:
         batch_options = (True,)
+    default_variants = variants is DEFAULT_VARIANTS
     out = []
     for part in candidate_partitions(config, oversubscribe):
         for method in methods:
+            vlist = list(variants)
+            if (method == REMOTE_DMA and default_variants
+                    and FUSED_VARIANT not in vlist):
+                vlist.append(FUSED_VARIANT)
             for batch in batch_options:
                 for k in ks:
-                    for variant in variants:
+                    for variant in vlist:
                         out.append(PlanChoice(
                             partition=part, method=method,
                             batch_quantities=batch, multistep_k=k,

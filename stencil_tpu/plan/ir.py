@@ -39,9 +39,21 @@ AUTO_SPMD = "auto-spmd"
 REMOTE_DMA = "remote-dma"
 METHODS = (AXIS_COMPOSED, DIRECT26, AUTO_SPMD, REMOTE_DMA)
 
+# The fused compute+exchange kernel variant (ROADMAP #5): still
+# Method.REMOTE_DMA — same kernel-initiated transport, zero ppermutes —
+# but ONE kernel per substep starts every neighbor copy boundary-first,
+# computes interior tiles while the DMAs fly, waits the recv semaphores,
+# then computes the boundary tiles. A PlanChoice carries it as
+# ``kernel_variant == FUSED_VARIANT`` so the autotuner searches it and
+# the plan DB persists it like any other point in the space.
+FUSED_VARIANT = "fused"
+
 # Wire-compression itemsizes the IR can model without importing jax/numpy
-# (bfloat16 is not a numpy dtype name; everything else resolves lazily).
-_WIRE_ITEMSIZE = {"bfloat16": 2, "float16": 2, "float32": 4, "float64": 8}
+# (bfloat16 / float8_* are not numpy dtype names; everything else resolves
+# lazily). The fp8 tier (float8_e4m3fn) quarters fp32 on-wire bytes the
+# way bfloat16 halves them — same narrowing policy, one more row.
+_WIRE_ITEMSIZE = {"bfloat16": 2, "float16": 2, "float32": 4, "float64": 8,
+                  "float8_e4m3fn": 1, "float8_e5m2": 1}
 
 
 def wire_itemsize(wire_dtype: Optional[str]) -> Optional[int]:
@@ -186,6 +198,47 @@ class RemoteDmaPhaseIR:
 
 
 @dataclass(frozen=True)
+class FusedPhaseIR:
+    """One per-direction message of a FUSED compute+exchange substep.
+
+    The fused kernel cannot use the composed x→y→z phase geometry: a
+    composed y slab carries x-halo data, so phase y's send depends on
+    phase x's receive — nothing could start boundary-first. Instead the
+    fused schedule sends one EXACT-extent message per active direction
+    (the DIRECT26 geometry re-transported): every message reads only the
+    sender's compute-region cells, so all of them start concurrently
+    before any compute, the interior tiles run while they fly, and the
+    boundary tiles run after the recv semaphores — the reference's 26
+    concurrent peer-access writes (§5.8), with the XLA collective path
+    bypassed exactly like :class:`RemoteDmaPhaseIR` (:meth:`collectives`
+    is ZERO by construction; :meth:`dmas` is 1 for a wire-crossing
+    direction, 0 for a self-wrap hand-off).
+
+    ``shape`` is the exact carrier extent (z, y, x) on a uniform
+    partition (radius along the direction's nonzero axes, block size on
+    the orthogonal ones); on uneven partitions the per-device extents
+    come from the size tables at lowering time and ``shape`` records the
+    base-block figure the byte model prices."""
+
+    direction: Tuple[int, int, int]       # (dx, dy, dz)
+    shape: Tuple[int, int, int]           # carrier extent (z, y, x)
+    src: Optional[Tuple[int, int, int]]   # uniform-only static starts (z, y, x)
+    dst: Optional[Tuple[int, int, int]]
+    crossing: bool                        # leaves the device (any ring axis)
+    wire_cells: int
+    local_cells: int
+
+    def collectives(self) -> int:
+        """Always 0: kernel-initiated copies, nothing on the XLA
+        collective path (the same pin as RemoteDmaPhaseIR)."""
+        return 0
+
+    def dmas(self) -> int:
+        """Async remote copies one carrier pays for this direction."""
+        return 1 if self.crossing else 0
+
+
+@dataclass(frozen=True)
 class ExchangePlan:
     """The full declarative exchange program for one (spec, mesh, method).
 
@@ -207,6 +260,10 @@ class ExchangePlan:
     axis_phases: Tuple[AxisPhaseIR, ...]  # always built (composed geometry)
     direct_phases: Tuple[DirectPhaseIR, ...] = ()
     remote_phases: Tuple[RemoteDmaPhaseIR, ...] = ()
+    # the fused compute+exchange variant's per-direction messages (only
+    # built when ``fused``; REMOTE_DMA-only — see FusedPhaseIR)
+    fused_phases: Tuple[FusedPhaseIR, ...] = ()
+    fused: bool = False
     synthesized: bool = False
     # bf16-on-the-wire halo compression: wire-crossing carriers narrow to
     # this dtype before the send and widen on unpack (None = native).
@@ -223,7 +280,7 @@ class ExchangePlan:
         if self.method == DIRECT26:
             return self.direct_phases
         if self.method == REMOTE_DMA:
-            return self.remote_phases
+            return self.fused_phases if self.fused else self.remote_phases
         return self.axis_phases
 
     def collectives_per_exchange(self, quantities: int = 1,
@@ -247,7 +304,8 @@ class ExchangePlan:
         if self.method != REMOTE_DMA:
             return 0
         carriers = dtype_groups if self.batch_quantities else quantities
-        return sum(p.dmas() for p in self.remote_phases) * carriers
+        phases = self.fused_phases if self.fused else self.remote_phases
+        return sum(p.dmas() for p in phases) * carriers
 
     def wire_bytes(self, itemsizes: Sequence[int],
                    floating: Optional[Sequence[bool]] = None) -> int:
@@ -286,10 +344,17 @@ class ExchangePlan:
             f"resident={self.resident}"
             + (" (schedule synthesized by the SPMD partitioner)"
                if self.synthesized else "")
+            + (" (fused compute+exchange kernel)" if self.fused else "")
             + (f" wire_dtype={self.wire_dtype}" if self.wire_dtype else ""),
         ]
         for p in self.phases:
-            if isinstance(p, RemoteDmaPhaseIR):
+            if isinstance(p, FusedPhaseIR):
+                lines.append(
+                    f"  dir {p.direction}: shape(zyx)={p.shape} permutes=0 "
+                    f"dmas={p.dmas()} wire_cells={p.wire_cells} "
+                    f"local_cells={p.local_cells}"
+                )
+            elif isinstance(p, RemoteDmaPhaseIR):
                 lines.append(
                     f"  axis {p.axis}: ring={p.ring} resident={p.resident} "
                     f"rm={p.rm} rp={p.rp} permutes=0 dmas={p.dmas()} "
@@ -486,21 +551,86 @@ def _remote_phases(axis_phases: Tuple[AxisPhaseIR, ...]
     )
 
 
+def _fused_phases(spec, mesh_dim: Dim3) -> Tuple[FusedPhaseIR, ...]:
+    """Fused-substep messages: the DIRECT26 exact-extent direction set,
+    re-transported as kernel-initiated copies. Every message reads only
+    sender compute-region cells — no message depends on another, so the
+    fused kernel starts all of them boundary-first and hides the wire
+    time behind interior tiles. ``crossing`` (and hence :meth:`dmas`) is
+    a plan-level fact: a direction crosses iff any of its nonzero axes
+    has more than one device; self-wrap directions are local hand-offs
+    (lossless under wire compression, exactly like composed self-wrap
+    phases). Face → edge → corner order (stable within each rank) so the
+    uneven-partition lowering can layer padded writes like DIRECT26."""
+    r = spec.radius
+    base = spec.base
+    off = spec.compute_offset()
+    uniform = spec.is_uniform()
+    nblocks = spec.num_blocks()
+    md = {"z": mesh_dim.z, "y": mesh_dim.y, "x": mesh_dim.x}
+    dirs = [d for d in DIRECTIONS_26 if r.dir(-d) != 0]
+    dirs.sort(key=lambda d: abs(d.x) + abs(d.y) + abs(d.z))
+    phases = []
+    for d in dirs:
+        shape, src, dst = [], [], []
+        for dc, s, rmin, rplus, o in zip(
+            (d.z, d.y, d.x),
+            (base.z, base.y, base.x),
+            (r.z(-1), r.y(-1), r.x(-1)),
+            (r.z(1), r.y(1), r.x(1)),
+            (off.z, off.y, off.x),
+        ):
+            if dc == 1:
+                shape.append(rmin)
+                src.append(o + s - rmin)
+                dst.append(o - rmin)
+            elif dc == -1:
+                shape.append(rplus)
+                src.append(o)
+                dst.append(o + s)
+            else:
+                shape.append(s)
+                src.append(o)
+                dst.append(o)
+        if any(e == 0 for e in shape):
+            continue
+        comp = {"z": d.z, "y": d.y, "x": d.x}
+        crossing = any(comp[a] != 0 and md[a] > 1 for a in ("z", "y", "x"))
+        cells = shape[0] * shape[1] * shape[2] * nblocks
+        phases.append(FusedPhaseIR(
+            direction=(d.x, d.y, d.z), shape=tuple(shape),
+            src=tuple(src) if uniform else None,
+            dst=tuple(dst) if uniform else None,
+            crossing=crossing,
+            wire_cells=cells if crossing else 0,
+            local_cells=0 if crossing else cells,
+        ))
+    return tuple(phases)
+
+
 def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
                resident: Optional[Dim3] = None,
-               wire_dtype: Optional[str] = None) -> ExchangePlan:
+               wire_dtype: Optional[str] = None,
+               fused: bool = False) -> ExchangePlan:
     """Build the ExchangePlan of one (GridSpec, mesh shape, method).
 
     Pure geometry — no jax, no devices. ``method`` may be the enum from
     ``parallel.exchange`` or its value string. ``mesh_dim`` is the device
     grid (x, y, z); ``resident`` (blocks stacked per device) defaults to
     ``spec.dim / mesh_dim`` and must divide it exactly. ``wire_dtype``
-    narrows wire-crossing carriers in the byte model (the bf16-on-the-wire
-    halo compression knob).
+    narrows wire-crossing carriers in the byte model (the bf16/fp8
+    on-the-wire halo compression knob). ``fused`` builds the fused
+    compute+exchange variant's per-direction message set (REMOTE_DMA
+    only, single-resident only — loud infeasibility otherwise).
     """
     mval = getattr(method, "value", method)
     if mval not in METHODS:
         raise ValueError(f"unknown exchange method {method!r}")
+    if fused and mval != REMOTE_DMA:
+        raise ValueError(
+            "the fused compute+exchange variant is a REMOTE_DMA lowering "
+            f"(kernel-initiated copies); got method {mval!r}"
+        )
     md = Dim3.of(mesh_dim)
     if spec.dim.x % md.x or spec.dim.y % md.y or spec.dim.z % md.z:
         raise ValueError(
@@ -509,12 +639,19 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
     if resident is None:
         resident = Dim3(spec.dim.x // md.x, spec.dim.y // md.y,
                         spec.dim.z // md.z)
+    if fused and resident != Dim3(1, 1, 1):
+        raise ValueError(
+            "the fused compute+exchange kernel supports single-resident "
+            f"partitions only (got resident {resident}); use the plain "
+            "REMOTE_DMA carrier or AXIS_COMPOSED for oversubscription"
+        )
     synthesized = mval == AUTO_SPMD
     axis_phases = _axis_phases(spec, md, resident, synthesized)
     direct_phases = (
         _direct_phases(spec, md, resident) if mval == DIRECT26 else ()
     )
     remote_phases = _remote_phases(axis_phases) if mval == REMOTE_DMA else ()
+    fused_phases = _fused_phases(spec, md) if fused else ()
     return ExchangePlan(
         method=mval,
         pack_groups="dtype" if batch_quantities else "quantity",
@@ -524,6 +661,8 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
         axis_phases=axis_phases,
         direct_phases=direct_phases,
         remote_phases=remote_phases,
+        fused_phases=fused_phases,
+        fused=fused,
         synthesized=synthesized,
         wire_dtype=wire_dtype,
     )
@@ -663,6 +802,11 @@ class PlanChoice:
             multistep_k=int(obj.get("multistep_k", 1)),
             kernel_variant=obj.get("kernel_variant"),
         )
+
+    @property
+    def is_fused(self) -> bool:
+        """The fused compute+exchange mega-kernel variant of REMOTE_DMA."""
+        return self.kernel_variant == FUSED_VARIANT
 
     def label(self) -> str:
         px, py, pz = self.partition

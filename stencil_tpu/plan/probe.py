@@ -13,7 +13,10 @@ method, quantity batching, and the DEEPENED radius of its temporal k
 (the k-step multistep exchanges radius*k halos once per k steps, so the
 probed per-step exchange cost is trimean/k). Kernel-variant candidates
 share the exchange probe — the variant's compute delta rides the static
-model until app-level probes exist (ROADMAP #1's TPU ledger).
+model until app-level probes exist (ROADMAP #1's TPU ledger) — EXCEPT the
+fused compute+exchange variant, whose exchange program itself differs
+(concurrent per-direction kernel-initiated transport) and is probed as
+such via ``time_exchange(fused=True)``.
 """
 
 from __future__ import annotations
@@ -57,6 +60,7 @@ def probe_choice(config: PlanConfig, choice: PlanChoice,
             chunk=chunk if chunk is not None else min(iters, 5),
             batch_quantities=choice.batch_quantities,
             partition=choice.partition,
+            fused=choice.is_fused,
         )
     trimean = r["trimean_s"]
     rec.gauge("plan.probe_trimean_s", trimean, phase="plan", unit="s",

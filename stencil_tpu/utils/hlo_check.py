@@ -140,9 +140,10 @@ def overlap_report(mlir_text: str, kernel_marker: str = "tpu_custom_call") -> di
 
 # -- collective census (bench_mpi_pack ablation accounting) ------------------
 
-# HLO element sizes in bytes for the dtypes this framework traffics in.
+# HLO element sizes in bytes for the dtypes this framework traffics in
+# (f8* are the fp8 wire-compression tier's carrier types).
 _DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1,
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
     "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
     "s32": 4, "u32": 4, "f32": 4,
     "s64": 8, "u64": 8, "f64": 8, "c64": 8,
@@ -163,7 +164,8 @@ COLLECTIVE_KINDS = (
 _COLLECTIVE_OP_RE = re.compile(
     r"=\s*[^=]*?\b(" + "|".join(COLLECTIVE_KINDS) + r")(-start)?\("
 )
-_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([0-9,]*)\]")
+# dtype token may carry interior digits (f8e4m3fn) — [a-z][a-z0-9]*
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
 _PAIR_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
 _GROUPS_RE = re.compile(r"replica_groups=\{((?:\{[\d,]*\},?)*)\}")
 
@@ -227,7 +229,8 @@ _STABLEHLO_OP_RE = re.compile(
 _STABLEHLO_RESULT_RE = re.compile(r"->\s*tensor<([0-9x]+)x([a-zA-Z0-9]+)>")
 _STABLEHLO_PAIRS_RE = re.compile(r"source_target_pairs\s*=[^:]*:\s*tensor<(\d+)x2xi64>")
 _STABLEHLO_DTYPE_BYTES = {
-    "i1": 1, "i8": 1, "ui8": 1, "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "i1": 1, "i8": 1, "ui8": 1, "f8E4M3FN": 1, "f8E5M2": 1,
+    "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
     "i32": 4, "ui32": 4, "f32": 4, "i64": 8, "ui64": 8, "f64": 8,
 }
 
