@@ -176,12 +176,18 @@ def _child_main(mode: str, resume: bool = False) -> int:
     import numpy as np
 
     def _exchange_leg(method, nq: int = 4, ndev: int = 1, nb: int = None,
-                      batched: bool = True, dim: Dim3 = None) -> float:
+                      batched: bool = True, dim: Dim3 = None,
+                      placement=None) -> float:
         nb = nb if nb is not None else n
         if dim is None:
             dim = Dim3(2, 2, 2) if ndev == 8 else Dim3(1, 1, 1)
         spec = GridSpec(Dim3(nb, nb, nb), dim, Radius.constant(3))
-        mesh = grid_mesh(spec.dim, jax.devices()[:ndev])
+        devs = jax.devices()[:ndev]
+        if placement is not None:
+            # topology-aware block placement: mesh position i hosted by
+            # devs[placement[i]] (the PlanChoice.placement convention)
+            devs = [devs[placement[i]] for i in range(len(devs))]
+        mesh = grid_mesh(spec.dim, devs, ordered=placement is not None)
         ex = HaloExchange(spec, mesh, method, batch_quantities=batched)
         loop = ex.make_loop(chunk)
         state = {
@@ -294,6 +300,29 @@ def _child_main(mode: str, resume: bool = False) -> int:
             ex_pq_gb_s = _exchange_leg(Method.AXIS_COMPOSED, batched=False, **ab)
         except Exception as e:
             errors["exchange_batched"] = f"{type(e).__name__}: {e}"[:400]
+
+    # topology-aware placement leg (ISSUE 15 / ROADMAP #6): the same
+    # composed exchange on an ANISOTROPIC 1x2x4 partition of the 8-dev
+    # mesh, identity device order vs a rotated block->device assignment
+    # (the PlanChoice.placement mechanism the QAP feeds). Results are
+    # bit-identical by construction; the tracked ratio is a parity/no-
+    # regression pin on the placed mesh path — on the single-process CPU
+    # mesh every link costs the same, so ~1.0 is the honest expectation
+    # and only a TPU slice (non-uniform ICI hops) can show a win.
+    ex_placed_gb_s = 0.0
+    ex_ident_gb_s = 0.0
+    if leg("halo exchange (placed vs identity)"):
+        try:
+            ndevp8 = 8 if len(jax.devices()) >= 8 else 1
+            pl = dict(nq=4, ndev=ndevp8, nb=min(n, 128),
+                      dim=Dim3(1, 2, 4) if ndevp8 == 8 else Dim3(1, 1, 1))
+            rot = tuple((i + 1) % ndevp8 for i in range(ndevp8))
+            ex_placed_gb_s = _exchange_leg(
+                Method.AXIS_COMPOSED, placement=rot if ndevp8 > 1 else None,
+                **pl)
+            ex_ident_gb_s = _exchange_leg(Method.AXIS_COMPOSED, **pl)
+        except Exception as e:
+            errors["exchange_placed"] = f"{type(e).__name__}: {e}"[:400]
 
     # exchange-plan autotuner leg (ROADMAP #3): tune (partition x method x
     # batching) for a radius-3 4-quantity config, then time the tuned plan
@@ -472,6 +501,16 @@ def _child_main(mode: str, resume: bool = False) -> int:
         "exchange_perq_gb_per_s": round(ex_pq_gb_s, 2),
         "exchange_batchedq_over_perq": (
             round(ex_bq_gb_s / ex_pq_gb_s, 3) if ex_pq_gb_s else 0.0
+        ),
+        # topology-aware placement leg: placed (rotated assignment) over
+        # identity on the anisotropic 1x2x4 8-dev partition — a parity/
+        # no-regression pin on CPU (uniform links -> ~1.0); the QAP win
+        # claim needs non-uniform ICI and lives in the TPU session
+        "exchange_placed_gb_per_s": round(ex_placed_gb_s, 2),
+        "exchange_identity_gb_per_s": round(ex_ident_gb_s, 2),
+        "exchange_placed_over_identity": (
+            round(ex_placed_gb_s / ex_ident_gb_s, 3)
+            if ex_ident_gb_s else 0.0
         ),
         # exchange-plan autotuner leg: tuned plan's bandwidth over the
         # plan-less default at the same config (> 1: the tuner won)

@@ -224,6 +224,194 @@ def audit_config(cfg: dict, devices=None,
     return v
 
 
+# -- placement conformance (the topology-aware PlanChoice leg) ---------------
+
+
+def placement_permutations(ndev: int, count: int = 3):
+    """``count`` deterministic NON-identity permutations of ``ndev``
+    mesh positions: reversal, rotation by one, and pairwise swaps —
+    the fixed fixture set the placement-parity gate sweeps (no RNG: a
+    CI failure must reproduce)."""
+    from ..plan.ir import validate_placement
+
+    perms = []
+    rev = tuple(range(ndev - 1, -1, -1))
+    rot = tuple((i + 1) % ndev for i in range(ndev))
+    swap = list(range(ndev))
+    for i in range(0, ndev - 1, 2):
+        # adjacent pairs swap; an odd ndev leaves the tail FIXED (the
+        # naive i+1/i-1 formula maps the last even index out of range —
+        # not a permutation at all)
+        swap[i], swap[i + 1] = swap[i + 1], swap[i]
+    candidates = [rev, rot, tuple(swap)]
+    k = 2
+    while k < ndev:
+        candidates.append(tuple((i + k) % ndev for i in range(ndev)))
+        k += 1
+    for p in candidates:
+        if len(perms) >= count:
+            break
+        # a broken fixture must never reach the auditor as a FAILED
+        # verdict on a healthy build
+        if (p != tuple(range(ndev)) and p not in perms
+                and validate_placement(p, ndev) is None):
+            perms.append(p)
+    return perms
+
+
+def _expected_flat_pairs(plan, mesh_dim):
+    """The compiled program's predicted collective-permute pair sets —
+    one frozenset of flattened (src, tgt) logical ids per expected op —
+    derived from the plan's axis phases (the logical schedule is
+    placement-INVARIANT: a placement rebinds which physical device sits
+    behind each logical id, never the schedule). AXIS_COMPOSED,
+    single-resident scope."""
+    from ..geometry import Dim3
+
+    md = Dim3.of(mesh_dim)
+
+    def lin(x, y, z):
+        return x + y * md.x + z * md.x * md.y
+
+    out = []
+    axis_n = {"x": md.x, "y": md.y, "z": md.z}
+    for phase in plan.axis_phases:
+        if axis_n[phase.axis] <= 1 or not phase.active:
+            continue
+        for step, active in ((1, phase.rm > 0), (-1, phase.rp > 0)):
+            if not active:
+                continue
+            pairs = set()
+            for z in range(md.z):
+                for y in range(md.y):
+                    for x in range(md.x):
+                        c = {"x": x, "y": y, "z": z}
+                        d = dict(c)
+                        d[phase.axis] = ((c[phase.axis] + step)
+                                         % axis_n[phase.axis])
+                        pairs.add((lin(x, y, z),
+                                   lin(d["x"], d["y"], d["z"])))
+            out.append(frozenset(pairs))
+    return out
+
+
+def audit_placement(size: int, radius: int,
+                    partition: Tuple[int, int, int],
+                    placement: Tuple[int, ...],
+                    devices=None) -> Verdict:
+    """One permutation's placement-conformance audit (AXIS_COMPOSED):
+
+    - the realized mesh's device order IS the permuted assignment
+      (mesh position i hosts ``devices[placement[i]]``);
+    - the compiled ``source_target_pairs`` match the plan's predicted
+      logical pair sets — so pair (s, t) rides the physical link
+      ``devices[placement[s]] -> devices[placement[t]]``, i.e. the
+      compiled schedule lands exactly on the permuted assignment;
+    - the exchanged field is bit-identical to the identity placement
+      (placement moves BLOCKS, never values).
+    """
+    import jax
+    import numpy as np
+
+    from ..geometry import Dim3, Radius
+    from ..parallel import HaloExchange, Method, grid_mesh
+    from ..parallel.exchange import shard_blocks, unshard_blocks
+    from ..utils.hlo_check import collective_permute_pairs
+
+    devices = list(devices) if devices is not None else jax.devices()
+    px, py, pz = partition
+    ndev = px * py * pz
+    label = (f"{size}^3/{px}x{py}x{pz}/placement="
+             + "-".join(str(v) for v in placement))
+    v = Verdict(label=label, method="axis-composed")
+    if ndev > len(devices):
+        v.skipped = True
+        v.ok = False
+        v.reason = (f"partition {partition} needs {ndev} devices; "
+                    f"{len(devices)} available")
+        return v
+    from ..domain.grid import GridSpec
+
+    spec = GridSpec(Dim3(size, size, size), Dim3(*partition),
+                    Radius.constant(radius))
+    base = devices[:ndev]
+    arranged = [base[placement[i]] for i in range(ndev)]
+    mesh = grid_mesh(spec.dim, arranged, ordered=True)
+    mesh_id = grid_mesh(spec.dim, base, ordered=True)
+
+    actual_order = [d.id for d in mesh.devices.flatten()]
+    expected_order = [base[placement[i]].id for i in range(ndev)]
+    ok = _check(v.checks, "mesh_device_order", expected_order,
+                actual_order)
+
+    ex = HaloExchange(spec, mesh, Method.AXIS_COMPOSED)
+    ex_id = HaloExchange(spec, mesh_id, Method.AXIS_COMPOSED)
+    g = spec.global_size
+    field = np.arange(g.x * g.y * g.z, dtype=np.float32).reshape(
+        g.z, g.y, g.x)
+    state = {0: shard_blocks(field, spec, mesh)}
+    state_id = {0: shard_blocks(field, spec, mesh_id)}
+
+    txt = ex._compiled.lower(state).compile().as_text()
+    actual_pairs = sorted(collective_permute_pairs(txt),
+                          key=lambda s: sorted(s))
+    expected_pairs = sorted(_expected_flat_pairs(ex.plan, spec.dim),
+                            key=lambda s: sorted(s))
+    ok &= _check(v.checks, "source_target_pairs",
+                 [sorted(p) for p in expected_pairs],
+                 [sorted(p) for p in actual_pairs])
+
+    out = unshard_blocks(ex(state)[0], spec)
+    out_id = unshard_blocks(ex_id(state_id)[0], spec)
+    ok &= _check(v.checks, "bit_identical_to_identity", True,
+                 bool(out.tobytes() == out_id.tobytes()))
+    v.ok = bool(ok)
+    return v
+
+
+def run_placement_sweep(count: int = 3, size: int = DEFAULT_SIZE,
+                        radius: int = DEFAULT_RADIUS,
+                        partition: Tuple[int, int, int] = (2, 2, 2),
+                        devices=None,
+                        rec: Optional["telemetry.Recorder"] = None) -> Dict:
+    """Audit ``count`` non-identity placements (the ISSUE-15 gate:
+    census pairs must match the permuted assignment, results bit-
+    identical). Emits the same ``analysis.plan_verdict`` vocabulary as
+    the method sweep."""
+    rec = rec or telemetry.get()
+    ndev = partition[0] * partition[1] * partition[2]
+    verdicts: List[Verdict] = []
+    for perm in placement_permutations(ndev, count):
+        with rec.span("analysis.verify_plan", phase="analysis",
+                      method="axis-composed"):
+            try:
+                v = audit_placement(size, radius, partition, perm,
+                                    devices=devices)
+            except Exception as e:  # an auditor crash is a FAILED config
+                v = Verdict(
+                    label=f"placement={'-'.join(str(i) for i in perm)}",
+                    method="axis-composed", ok=False,
+                    reason=f"{type(e).__name__}: {e}")
+        verdicts.append(v)
+        rec.meta("analysis.plan_verdict", method=v.method, ok=int(v.ok),
+                 label=v.label, skipped=int(v.skipped),
+                 reason=v.reason or None)
+        if not v.ok and not v.skipped:
+            rec.counter("analysis.plan_mismatch", value=1,
+                        phase="analysis", method=v.method)
+    checked = [v for v in verdicts if not v.skipped]
+    failed = [v for v in checked if not v.ok]
+    skipped = [v for v in verdicts if v.skipped]
+    rec.meta("analysis.plan_sweep", checked=len(checked),
+             failed=len(failed), skipped=len(skipped))
+    return {
+        "verdicts": verdicts,
+        "checked": len(checked),
+        "failed": len(failed),
+        "skipped": len(skipped),
+    }
+
+
 def run_sweep(configs: Sequence[dict], devices=None,
               perturb_collectives: int = 0, perturb_wire: int = 0,
               perturb_dmas: int = 0,

@@ -275,17 +275,38 @@ class DistributedDomain:
                     )
                 mesh_dim = _stack_residents(dim, c)
             self.spec = GridSpec(self.size, dim, self.radius)
+            ordered = False
             if self._placement is not None and mesh_dim != dim:
                 log.warn(
                     "placement strategies assume one block per device; "
                     "ignoring set_placement for the oversubscribed partition"
                 )
-            if self._placement is not None and mesh_dim == dim:
+            elif self._placement is not None:
                 devices = self._placement.arrange(devices, self.spec)
-            self.mesh = grid_mesh(
-                mesh_dim, devices,
-                ordered=self._placement is not None and mesh_dim == dim,
-            )
+                ordered = True
+            ch = self._plan_choice
+            if ch is not None and ch.placement is not None:
+                # the tuned topology-aware placement: mesh position i
+                # (row-major z, y, x — residents stack WITHIN a
+                # position, so oversubscription composes) is hosted by
+                # devices[placement[i]]. An explicit set_placement
+                # strategy wins, with a warning — like set_partition
+                # over the tuned partition.
+                if ordered:
+                    log.warn(
+                        "explicit set_placement overrides the tuned "
+                        f"plan's placement {list(ch.placement)}; probes "
+                        "measured the tuned assignment, not this one"
+                    )
+                else:
+                    from .plan.ir import validate_placement
+
+                    err = validate_placement(ch.placement, n)
+                    if err is not None:
+                        raise ValueError(f"tuned plan placement: {err}")
+                    devices = [devices[ch.placement[i]] for i in range(n)]
+                    ordered = True
+            self.mesh = grid_mesh(mesh_dim, devices, ordered=ordered)
         self.time_plan = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -457,6 +478,7 @@ class DistributedDomain:
             multistep_k=ch.multistep_k if ch is not None else 1,
             kernel_variant=(ch.kernel_variant if ch is not None
                             else FUSED_VARIANT if self._fused else None),
+            placement=ch.placement if ch is not None else None,
         )
         return {"key": cfg.to_json(), "choice": choice.to_json(),
                 "tuned": ch is not None,
@@ -469,12 +491,22 @@ class DistributedDomain:
         here = self.plan_meta()
         saved_ch = dict(saved.get("choice") or {})
         here_ch = dict(here["choice"])
+        # pre-placement snapshots never wrote the field: an absent
+        # placement IS the identity assignment (the plan-DB migration
+        # rule), so normalize both sides before comparing — a build
+        # upgrade must not make every old snapshot warn
+        saved_ch.setdefault("placement", None)
+        here_ch.setdefault("placement", None)
         if not (saved.get("tuned") or here["tuned"]):
             # neither side went through the tuner: a partition-only delta
             # is the supported elastic mesh-reshape resume (PR 4) and must
-            # stay quiet; method/batching deltas still mix programs
+            # stay quiet (and so must a placement-only one — both are
+            # realize()-time layout facts, not tuned verdicts);
+            # method/batching deltas still mix programs
             saved_ch.pop("partition", None)
             here_ch.pop("partition", None)
+            saved_ch.pop("placement", None)
+            here_ch.pop("placement", None)
         # the comparison is data-driven (plain dicts), so a snapshot
         # written under a method this build does not know — REMOTE_DMA
         # from a newer build, or any future transport — still WARNS
@@ -501,6 +533,67 @@ class DistributedDomain:
                 "(--autotune) or pass the snapshot's plan to keep "
                 "measurements comparable"
             )
+
+    def replan(self, choice) -> None:
+        """Hot-swap the exchange plan of a REALIZED domain, in place —
+        the mid-run half of ROADMAP #6 (the PR-12 ``replan.requested``
+        hook's consumer, driven by :class:`stencil_tpu.plan.replan.
+        ReplanController` between guarded-loop chunks).
+
+        ``choice`` (a ``plan.ir.PlanChoice`` or its JSON dict) is applied
+        as a UNIT — partition, method, batching, kernel variant, and
+        block placement; any explicit ``set_partition`` pin is cleared,
+        exactly like a fresh tuned realize. The swap is the elastic
+        ckpt restore without the disk: gather every quantity's global
+        interior (pure host copies — bit-exact), re-realize under the
+        new plan (the compile cache of already-seen programs makes this
+        cheap), re-scatter, and rebuild the exteriors with one halo
+        exchange. State after the swap is bit-identical to before it."""
+        from .plan.ir import PlanChoice
+
+        if not self._realized:
+            raise RuntimeError(
+                "replan() requires a realized domain (use set_plan "
+                "before realize() for the initial choice)")
+        if isinstance(choice, dict):
+            choice = PlanChoice.from_json(choice)
+        with timer.timed("setup.replan"), timer.trace_range("stencil.replan"):
+            globs = {
+                idx: unshard_blocks(self._curr[idx], self.spec)
+                for idx in self._curr
+            }
+            old_choice = self._plan_choice
+            old_partition = self._partition_dim
+
+            def _install(ch):
+                self._plan_choice = ch
+                self._realized = False
+                self._curr = {}
+                self._next = {}
+                self.realize()
+                for idx, g in globs.items():
+                    self._curr[idx] = shard_blocks(
+                        g.astype(self._dtypes[idx]), self.spec, self.mesh)
+                if self.radius.max_radius() > 0:
+                    # one exchange rebuilds every exterior on the new
+                    # layout (idempotent on exchanged data — the
+                    # elastic-restore rule)
+                    self.exchange()
+
+            self._partition_dim = None
+            try:
+                _install(choice)
+            except Exception:
+                # a choice that cannot realize (bad tuned placement, a
+                # partition the live device set no longer divides) must
+                # not leave the domain torn: the ReplanController's
+                # "rejected — continuing on the old plan" contract is
+                # only true if the old plan is actually back. Re-realize
+                # the old choice, re-shard the gathered state, and let
+                # the original exception propagate as the rejection.
+                self._partition_dim = old_partition
+                _install(old_choice)
+                raise
 
     # -- checkpoint / restart (ckpt/ subsystem) ------------------------------
     def save_checkpoint(self, ckpt_dir: str, step: int, *, keep: int = 3,

@@ -235,8 +235,15 @@ def time_exchange(
     turns on the (lossy) bf16/fp8-on-the-wire carrier compression;
     ``fused`` times the fused compute+exchange variant's concurrent
     per-direction transport (REMOTE_DMA only — the autotuner's fused
-    candidates probe through here)."""
+    candidates probe through here). ``placement`` is a Placement
+    strategy OR a plain assignment tuple (``PlanChoice.placement`` —
+    wrapped in :class:`~stencil_tpu.parallel.FixedAssignment` so placed
+    plan candidates probe on exactly their tuned mesh)."""
     devices = list(devices) if devices is not None else jax.devices()
+    if placement is not None and not hasattr(placement, "arrange"):
+        from ..parallel import FixedAssignment
+
+        placement = FixedAssignment(placement)
     dd = DistributedDomain(size.x, size.y, size.z)
     dd.set_radius(radius)
     dd.set_methods(method)

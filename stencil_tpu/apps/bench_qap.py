@@ -5,7 +5,19 @@ bin/bench_qap.cu:16-60): times the exact and greedy solvers on random,
 matched (cost rewards identity), and block-diagonal matrices across sizes,
 comparing the native C++ and pure-Python implementations.
 
+``--derived`` additionally times both solvers on the REAL placement
+inputs of the topology-aware plan leg — the wire-volume matrix of a
+GridSpec (``plan/cost.placement_wire_matrix``, the same halo_extent
+geometry the IR's wire model prices) against the link-cost matrix read
+from the live devices (``parallel/topology.link_cost_matrix``: ICI hop
+distance on TPU, the process-boundary ladder elsewhere) — and records
+``qap.placement_cost`` (the best achieved wire-bytes x link-cost) and
+``qap.improvement`` (identity cost / best cost; 1.0 where identity is
+already optimal, e.g. any uniform single-process CPU mesh) gauges.
+
 Usage: python -m stencil_tpu.apps.bench_qap --sizes 4 6 8 --catch-sizes 16 32 64
+       python -m stencil_tpu.apps.bench_qap --derived --cpu 8 --x 64 \
+           --partition 1x2x4
 """
 
 from __future__ import annotations
@@ -75,6 +87,47 @@ def run(sizes=(4, 6, 8), catch_sizes=(16, 32, 64), timeout_s=2.0):
     return rows
 
 
+def run_derived(x: int, y: int, z: int, radius: int, partition,
+                ndev: int, timeout_s: float, itemsize: int = 4) -> list:
+    """Time ``solve`` vs ``solve_catch`` on the DERIVED placement
+    matrices — the plan leg's real inputs, not synthetic fixtures. The
+    link-cost matrix comes from the live backend's devices, so this is
+    the one bench row that measures what an autotune-time placement
+    search actually pays. Imports jax lazily: the synthetic rows stay
+    backend-free."""
+    import jax
+
+    from ..domain.grid import GridSpec
+    from ..geometry import Dim3, Radius
+    from ..parallel.topology import link_cost_matrix
+    from ..plan.cost import placement_cost, placement_wire_matrix
+
+    devices = jax.devices()[:ndev] if ndev else jax.devices()
+    part = Dim3.of(partition)
+    if part.flatten() != len(devices):
+        raise SystemExit(
+            f"--partition {part} needs {part.flatten()} devices; "
+            f"{len(devices)} available")
+    spec = GridSpec(Dim3(x, y, z), part, Radius.constant(radius))
+    w = placement_wire_matrix(spec, part, per_cell_bytes=itemsize)
+    link = link_cost_matrix(devices)
+    identity = placement_cost(w, link)
+    rows = []
+    for solver, fn in (("exact", lambda: qap.solve(w, link,
+                                                   timeout_s=timeout_s)),
+                       ("catch", lambda: qap.solve_catch(w, link))):
+        t0 = time.perf_counter()
+        f, cost = fn()
+        rows.append({
+            "solver": solver, "kind": "derived", "n": len(devices),
+            "cost": cost, "s": time.perf_counter() - t0,
+            "identity_cost": identity,
+            "improvement": (identity / cost) if cost > 0 else 1.0,
+            "assignment": f,
+        })
+    return rows
+
+
 def main(argv: Optional[list] = None) -> int:
     from ..obs import telemetry
     from ._bench_common import add_metrics_flags, finish_metrics, start_metrics
@@ -83,8 +136,30 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--sizes", type=int, nargs="+", default=[4, 6, 8])
     p.add_argument("--catch-sizes", type=int, nargs="+", default=[16, 32, 64])
     p.add_argument("--timeout", type=float, default=2.0)
+    p.add_argument("--derived", action="store_true",
+                   help="also time both solvers on the derived placement "
+                        "matrices (GridSpec wire volumes x live-device "
+                        "link costs) and record qap.placement_cost / "
+                        "qap.improvement")
+    p.add_argument("--x", type=int, default=64)
+    p.add_argument("--y", type=int, default=64)
+    p.add_argument("--z", type=int, default=64)
+    p.add_argument("--radius", type=int, default=2,
+                   help="halo radius of the --derived GridSpec")
+    p.add_argument("--partition", default="",
+                   help="--derived block grid as PXxPYxPZ (default: "
+                        "2x2x2 at 8 devices, 1x1xN otherwise)")
+    p.add_argument("--ndev", type=int, default=0,
+                   help="--derived device count (0 = all)")
+    p.add_argument("--cpu", type=int, default=0,
+                   help="force N virtual CPU devices (--derived)")
     add_metrics_flags(p)
     args = p.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
     rec = start_metrics(args, "bench_qap")
     print("solver,kind,n,cost,s")
     for row in run(tuple(args.sizes), tuple(args.catch_sizes), args.timeout):
@@ -98,6 +173,27 @@ def main(argv: Optional[list] = None) -> int:
                       solver=row["solver"], matrix=row["kind"], n=row["n"])
             rec.gauge("qap.cost", row["cost"], phase="solve",
                       solver=row["solver"], matrix=row["kind"], n=row["n"])
+    if args.derived:
+        import jax
+
+        ndev = args.ndev or len(jax.devices())
+        part = args.partition or ("2x2x2" if ndev == 8 else f"1x1x{ndev}")
+        part = tuple(int(v) for v in part.split("x"))
+        for row in run_derived(args.x, args.y, args.z, args.radius, part,
+                               ndev, args.timeout):
+            print(f"{row['solver']}-derived,{row['kind']},{row['n']},"
+                  f"{row['cost']:.4f},{row['s']:.4f},"
+                  f"improvement={row['improvement']:.4f}")
+            if rec.enabled:
+                rec.gauge("qap.solve_s", row["s"], phase="solve", unit="s",
+                          solver=row["solver"], matrix=row["kind"],
+                          n=row["n"])
+                rec.gauge("qap.placement_cost", row["cost"], phase="solve",
+                          solver=row["solver"], matrix=row["kind"],
+                          n=row["n"])
+                rec.gauge("qap.improvement", row["improvement"],
+                          phase="solve", solver=row["solver"],
+                          matrix=row["kind"], n=row["n"])
     finish_metrics(rec)
     return 0
 

@@ -134,6 +134,38 @@ def run_modes(args, campaign_dir: str, sentinel=None, status=None) -> dict:
     bat = None
     if args.mode in ("batched", "ab"):
         cache = CompileCache()
+        controller = None
+        if getattr(args, "replan", False) and sentinel is not None:
+            # the campaign's between-slot swap: a latched
+            # replan.requested re-tunes the bucket's exchange-plan
+            # config (force=True, static-only — slots must not stall on
+            # probes) and persists the verdict into --plan-db, where
+            # every later plan consumer replays it. The slot programs
+            # themselves are bucket-keyed (batch-axis, zero-collective):
+            # the apply is the DB install, not a mid-slot reshard.
+            from ..campaign.driver import WORKLOADS
+            from ..geometry import Dim3, Radius
+            from ..plan.replan import ReplanController
+
+            wl = WORKLOADS[args.workload]
+            nq = len(wl.quantity_names(args.dtype))
+            radius = Radius.constant(wl.default_radius)
+
+            def retune_fn():
+                from ..plan.autotune import autotune as _plan_autotune
+
+                res = _plan_autotune(
+                    Dim3(args.size, args.size, args.size), radius,
+                    [args.dtype] * nq, devices=devices,
+                    db_path=args.plan_db or None, probe=False, force=True,
+                )
+                return res.choice
+
+            controller = ReplanController(
+                retune_fn, lambda choice, st: None, sentinel=sentinel)
+            sentinel.on_replan = controller.request
+        elif getattr(args, "replan", False):
+            log.warn("campaign: --replan needs --live-sentinel; ignoring")
         drv = CampaignDriver(
             jobs, args.slot, campaign_dir,
             devices=devices, chunk=args.chunk,
@@ -143,9 +175,12 @@ def run_modes(args, campaign_dir: str, sentinel=None, status=None) -> dict:
             rollback_backoff=args.rollback_backoff,
             inject=args.inject or None, inject_seed=args.inject_seed,
             resume=args.resume, cache=cache, use_pallas=args.use_pallas,
-            sentinel=sentinel, status=status,
+            sentinel=sentinel, status=status, replan=controller,
         )
         bat = drv.run()
+        if controller is not None:
+            out["replans_applied"] = controller.swaps
+            out["replans_rejected"] = controller.rejected
         out["batched_mcells_per_s"] = round(
             bat["aggregate_mcells_per_s"], 3)
         out["batched_p50_step_s"] = _round6(bat["p50_step_s"])
@@ -242,6 +277,14 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--inject-seed", type=int, default=None)
     p.add_argument("--init-seed", type=int, default=0,
                    help="tenant i's initial field is seeded init-seed + i")
+    p.add_argument("--replan", action="store_true",
+                   help="between-slot plan hot-swap (needs "
+                        "--live-sentinel, batched/ab mode): a latched "
+                        "replan.requested re-tunes the bucket's exchange "
+                        "plan at the next slot boundary and persists it "
+                        "to --plan-db (replan.applied/rejected records)")
+    p.add_argument("--plan-db", default="",
+                   help="plan DB the --replan re-tune persists into")
     p.add_argument("--use-pallas", action="store_true",
                    help="batched Pallas fast path (TPU; aligned layout)")
     p.add_argument("--deadline-ms", default="",
@@ -275,6 +318,19 @@ def main(argv: Optional[list] = None) -> int:
         if args.live_sentinel:
             p.error("--live-sentinel rides the batched driver; --mode "
                     "sequential runs outside it (use batched or ab)")
+        if args.replan:
+            # same slot-boundary machinery: sequential serving has no
+            # slots to swap between
+            p.error("--replan swaps plans at slot boundaries of the "
+                    "batched driver; --mode sequential has none "
+                    "(use batched or ab)")
+    if args.replan and not args.plan_db:
+        # the campaign swap's APPLY is the DB install — without a DB the
+        # re-tune would persist nowhere, no slot program would ever
+        # consult it, and replan.applied would claim a swap that did
+        # nothing (the sibling misuses error loudly; so does this one)
+        p.error("--replan persists the re-tuned plan into --plan-db; "
+                "pass one (the swap would otherwise install nothing)")
         if args.status_file:
             # may come from the globally-exported STENCIL_STATUS_FILE
             # env var rather than the command line — warn + ignore

@@ -136,6 +136,13 @@ def cmd_diff(args) -> int:
     sa, sb = resolve_snapshot(args.a), resolve_snapshot(args.b)
     ma, mb = load_manifest(sa), load_manifest(sb)
     diffs = _meta_diffs(ma, mb)
+    if getattr(args, "elastic", False):
+        # elastic comparison: the two snapshots may legitimately live on
+        # different partitions of the SAME global grid (a mesh-reshape
+        # resume, or a mid-run plan hot-swap) — the claim under test is
+        # the assembled payload, so a partition-only meta delta is not a
+        # difference. Grid/quantity/step deltas still are.
+        diffs = [d for d in diffs if not d.startswith("partition")]
     # data comparison only makes sense on a shared grid + quantity set
     comparable = not any(d.startswith(("global", "quantities")) for d in diffs)
     if args.data and comparable:
@@ -189,6 +196,11 @@ def main(argv: Optional[list] = None) -> int:
     pd = sub.add_parser("diff", help="compare two snapshots")
     pd.add_argument("a")
     pd.add_argument("b")
+    pd.add_argument("--elastic", action="store_true",
+                    help="ignore partition-shape meta deltas: compare "
+                         "two partitions of the same global grid (a "
+                         "mesh-reshape resume or a mid-run plan "
+                         "hot-swap) by their assembled payloads")
     pd.add_argument("--data", action="store_true",
                     help="also require bit-exact payload equality")
     pd.set_defaults(fn=cmd_diff)

@@ -82,6 +82,8 @@ def run(
     fused: bool = False,
     sentinel=None,
     status=None,
+    replan: bool = False,
+    replan_probe: bool = False,
 ) -> dict:
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -105,6 +107,7 @@ def run(
     # deep_halo > 1 realizes radius-k halos so the fused loop can take the
     # communication-avoiding multistep on multi-block meshes (one radius-k
     # exchange per k steps); the workload stays radius-1 jacobi
+    tight_x = False
     pdim = None
     if partition is not None:
         pdim = Dim3.of(partition)
@@ -129,6 +132,7 @@ def run(
         from ..geometry import Radius
 
         dd.set_radius(Radius.constant(deep_halo).without_x())
+        tight_x = True
     else:
         dd.set_radius(deep_halo)
     dd.set_methods(method)
@@ -301,6 +305,53 @@ def run(
             quarantine_snapshot(ckpt_dir, snapshot_name(s),
                                 reason="restored state failed health check")
 
+    # The mid-run plan hot-swap (ROADMAP #6, the half PR 12's sentinel
+    # was waiting for): when the live sentinel fires replan.requested,
+    # the controller re-probes the autotuner between chunks and installs
+    # the winning compiled plan via DistributedDomain.replan — the
+    # in-memory elastic reshard, bit-identical by construction. Needs the
+    # sentinel (the trigger) and a full-radius layout (the tight-x pin
+    # realizes no x halos, which only the pinned partition can run).
+    controller = None
+    if replan and sentinel is None:
+        log.warn("--replan needs --live-sentinel (replan.requested is "
+                 "the trigger); ignoring")
+    elif replan and tight_x:
+        log.warn("--replan is unavailable under the tight-x no-x-halo "
+                 "layout (a retuned x-split partition could not realize "
+                 "it); ignoring")
+    elif replan:
+        from ..parallel.topology import link_cost_matrix
+        from ..plan.ir import PlanChoice, PlanConfig
+        from ..plan.replan import ReplanController
+
+        def retune_fn():
+            from ..plan.autotune import autotune as _plan_autotune
+
+            res = _plan_autotune(
+                dd.size, dd.radius, list(dd._dtypes), devices=devices,
+                db_path=plan_db, probe=replan_probe, force=True,
+            )
+            return res.choice
+
+        def apply_replan(choice, st):
+            nonlocal sel, nxt
+            dd.set_curr(h, st["temperature"])
+            dd.replan(choice)
+            loops.clear()  # the old plan's compiled loops are stale
+            sel = shard_blocks(sphere_sel(size), dd.spec, dd.mesh)
+            nxt = dd.get_next(h)
+            return {"temperature": dd.get_curr(h)}
+
+        controller = ReplanController(
+            retune_fn, apply_replan, sentinel=sentinel,
+            current_choice=PlanChoice.from_json(dd.plan_meta()["choice"]),
+            config=PlanConfig.make(dd.size, dd.radius, list(dd._dtypes),
+                                   n, devices[0].platform),
+            link_costs=link_cost_matrix(devices),
+        )
+        sentinel.on_replan = controller.request
+
     loop_t0 = time.perf_counter()
     state, done = run_guarded(
         {"temperature": curr},
@@ -311,7 +362,7 @@ def run(
         save_fn=save_fn, ckpt_every=ckpt_every, restore_fn=restore_fn,
         quarantine_fn=quarantine_fn, flush_fn=flush_fn, on_chunk=on_chunk,
         spec=dd.spec, ckpt_dir=ckpt_dir, app="jacobi3d",
-        sentinel=sentinel, status=status,
+        sentinel=sentinel, status=status, replan=controller,
     )
     # whole-loop wall clock, INCLUDING what the per-chunk spans exclude
     # (health checks, checkpoint saves, injected faults, backoff and
@@ -319,6 +370,10 @@ def run(
     # (scripts/ci_perf_gate.py trips it with an injected slow: fault)
     loop_wall_s = time.perf_counter() - loop_t0
     curr = state["temperature"]
+    if controller is not None and controller.swaps:
+        # the CSV row and byte accounting must describe the plan that
+        # FINISHED the run, not the one it started on
+        method = dd._method
     if ckpt_dir:
         if done > start or start == 0:
             # the final state is always durable (step == iters), so a
@@ -487,6 +542,17 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--plan-db", type=str, default="",
                    help="on-disk plan DB (JSON) for --autotune; also "
                         "inspectable via apps/plan_tool.py")
+    p.add_argument("--replan", action="store_true",
+                   help="mid-run plan hot-swap (needs --live-sentinel): "
+                        "on replan.requested the autotuner re-tunes "
+                        "between chunks and the winning compiled plan is "
+                        "installed in place (replan.applied/rejected in "
+                        "the metrics; state is bit-identical across the "
+                        "swap)")
+    p.add_argument("--replan-probe", action="store_true",
+                   help="with --replan, refine the re-tune with measured "
+                        "probes (default: static ranking only, so the "
+                        "swap stays cheap)")
     p.add_argument("--wire-dtype", type=str, default="",
                    help="on-the-wire halo compression (bfloat16 or the fp8 "
                         "tier float8_e4m3fn): wire-crossing "
@@ -570,6 +636,8 @@ def main(argv: Optional[list] = None) -> int:
             fused=args.fused,
             sentinel=sentinel,
             status=status,
+            replan=args.replan,
+            replan_probe=args.replan_probe,
         )
     except RecoveryExhausted as e:
         # the loud-degrade contract: evidence bundle on disk, the distinct

@@ -207,6 +207,16 @@ def cmd_verify_plan(args) -> int:
                        perturb_collectives=args.perturb_collectives,
                        perturb_wire=args.perturb_wire,
                        perturb_dmas=args.perturb_dmas, rec=rec)
+    if getattr(args, "placements", 0):
+        pres = vp.run_placement_sweep(
+            count=args.placements, size=args.size, radius=args.radius,
+            partition=_parse_partitions(args.partitions)[0], rec=rec)
+        res = {
+            "verdicts": res["verdicts"] + pres["verdicts"],
+            "checked": res["checked"] + pres["checked"],
+            "failed": res["failed"] + pres["failed"],
+            "skipped": res["skipped"] + pres["skipped"],
+        }
     verdicts = res["verdicts"]
     if args.json:
         print(json.dumps({
@@ -339,6 +349,13 @@ def main(argv: Optional[list] = None) -> int:
                              "(the auditor must TRIP — CI's proof knob)")
         sp.add_argument("--perturb-wire", type=int, default=0)
         sp.add_argument("--perturb-dmas", type=int, default=0)
+        sp.add_argument("--placements", type=int, default=0,
+                        help="ALSO audit N non-identity block placements "
+                             "on the first partition: mesh device order "
+                             "== the permuted assignment, compiled "
+                             "source_target_pairs == the plan's logical "
+                             "schedule, results bit-identical to "
+                             "identity (the ISSUE-15 placement gate)")
 
     def audit_flags(sp):
         sp.add_argument("--size", type=int, default=16)

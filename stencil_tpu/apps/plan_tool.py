@@ -124,7 +124,64 @@ def cmd_explain(args) -> int:
                      else "DB" if entry is not None else "best static")
                   + " choice:")
             print(plan.describe())
+            if args.placement:
+                _explain_placement(args, config, best, spec, mesh_dim)
     return 0
+
+
+def _explain_placement(args, config, choice, spec, mesh_dim) -> None:
+    """The ``explain --placement`` table: the choice's block→device
+    assignment plus the per-pair wire-bytes x link-cost products the
+    QAP minimized. Jax-free: link costs come from ``--link-costs``
+    (a JSON ndev x ndev matrix, e.g. a dumped
+    ``parallel.topology.link_cost_matrix``) or default to uniform —
+    under which every placement prices identically, and the table says
+    so instead of implying a win."""
+    import numpy as np
+
+    from ..geometry import Dim3
+    from ..plan.cost import placement_cost, placement_wire_matrix
+
+    md = Dim3.of(mesh_dim)
+    n = md.flatten()
+    w = placement_wire_matrix(spec, md,
+                              per_cell_bytes=sum(config.itemsizes()))
+    if args.link_costs:
+        with open(args.link_costs) as fh:
+            link = np.asarray(json.load(fh), dtype=np.float64)
+        if link.shape != (n, n):
+            raise SystemExit(
+                f"--link-costs matrix is {link.shape}; the mesh has "
+                f"{n} positions")
+        src = args.link_costs
+    else:
+        link = np.ones((n, n))
+        np.fill_diagonal(link, 0.0)
+        src = "uniform default (pass --link-costs for a real fabric)"
+    f = (list(choice.placement) if choice.placement is not None
+         else list(range(n)))
+    print(f"placement ({'tuned' if choice.placement is not None else 'identity'}; link costs: {src}):")
+    for i in range(n):
+        iz, rem = divmod(i, md.x * md.y)
+        iy, ix = divmod(rem, md.x)
+        print(f"  mesh ({ix},{iy},{iz}) -> device {f[i]}")
+    print("per-pair wire-bytes x link-cost (placed devices):")
+    print("  pair(mesh),devices,wire_bytes,link_cost,product")
+    for a in range(n):
+        for b in range(n):
+            if b <= a or (w[a, b] == 0 and w[b, a] == 0):
+                continue
+            wb = w[a, b] + w[b, a]
+            lc = link[f[a], f[b]]
+            print(f"  {a}-{b},{f[a]}-{f[b]},{int(wb)},{lc:g},"
+                  f"{wb * lc:g}")
+    ident = placement_cost(w, link)
+    placed = placement_cost(w, link, f)
+    print(f"total modeled wire cost: placed {placed:g} vs identity "
+          f"{ident:g}"
+          + (f" ({ident / placed:.3f}x better)" if placed < ident else
+             " (identity-equivalent)" if placed == ident else
+             " (WORSE than identity — re-tune)"))
 
 
 def cmd_prune(args) -> int:
@@ -258,6 +315,15 @@ def main(argv: Optional[list] = None) -> int:
     sp.add_argument("--wire-dtype", default="",
                     help="render the plan's wire bytes under this "
                          "wire-compression dtype (e.g. bfloat16)")
+    sp.add_argument("--placement", action="store_true",
+                    help="also render the choice's block→device "
+                         "assignment and the per-pair wire-bytes x "
+                         "link-cost table the placement QAP minimized")
+    sp.add_argument("--link-costs", default="",
+                    help="JSON ndev x ndev link-cost matrix for "
+                         "--placement (e.g. a dumped "
+                         "parallel.topology.link_cost_matrix); default "
+                         "uniform")
     _add_config_flags(sp)
 
     sp = sub.add_parser("prune", help="drop entries by filter")

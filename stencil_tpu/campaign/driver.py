@@ -313,6 +313,7 @@ class CampaignDriver:
         sentinel=None,
         status=None,
         slo_min_samples: int = 3,
+        replan=None,
     ):
         if slot_size < 1:
             raise ValueError(f"slot_size must be >= 1, got {slot_size}")
@@ -350,6 +351,12 @@ class CampaignDriver:
         # writer gets the per-lane tenant table each chunk
         self.sentinel = sentinel
         self.status = status
+        # the campaign's plan hot-swap (ROADMAP #6, between slots): a
+        # slot's compiled program is bucket-keyed and must not change
+        # under a running slot, so the swap point is the slot boundary —
+        # a latched replan.requested re-tunes there and the next slot's
+        # programs consult the re-tuned plan (plan/replan.py)
+        self.replan = replan
         # a tenant's online p99 is judged against its deadline only once
         # this many latency samples exist (a single cold-cache chunk must
         # not condemn a tenant)
@@ -438,6 +445,11 @@ class CampaignDriver:
             cell_steps += stats["cell_steps"]
             wall += stats["wall_s"]
             slot_idx += 1
+            if self.replan is not None and self.replan.pending:
+                # between slots: the same swap the guarded single-domain
+                # loop performs between chunks (run_guarded's replan=),
+                # at the campaign's own safe boundary
+                self.replan.maybe_swap(None, slot_idx)
         agg = cell_steps / wall / 1e6 if wall > 0 else 0.0
         summary = {
             "results": results,

@@ -145,6 +145,7 @@ def run_guarded(
     sentinel=None,
     sentinel_key: str = "step.latency_s",
     status=None,
+    replan=None,
 ) -> Tuple[Dict, int]:
     """Drive the step loop from ``start`` to ``iters``; returns the final
     ``(state, step)``.
@@ -174,6 +175,15 @@ def run_guarded(
     - ``status`` (:class:`~stencil_tpu.obs.status.StatusWriter`) gets an
       atomic snapshot rewrite per chunk: current step, rolling latency,
       health counts, anomaly state — the file ``report --status`` polls.
+    - ``replan`` (:class:`~stencil_tpu.plan.replan.ReplanController`)
+      closes the ROADMAP #6 loop: when the sentinel's ``on_replan`` hook
+      latched a request, the engine finishes the current chunk and then
+      performs the swap — retune, install the new compiled plan, emit
+      ``replan.applied``/``replan.rejected`` — BETWEEN chunks, where a
+      rebuild cannot tear a step; a rejected swap continues on the old
+      plan. The controller may return a re-sharded state (the new
+      plan's partition may differ), which replaces ``state`` for the
+      remaining chunks.
     """
     rec = telemetry.get()
     policy = policy or RecoveryPolicy()
@@ -272,6 +282,15 @@ def run_guarded(
                 # its sections into the SAME atomic write — one
                 # fsync+rename per chunk, not two
                 _status_update(done, cycle)
+                if replan is not None and replan.pending:
+                    # the chunk is finished and its status is durable:
+                    # the one safe point to swap the compiled plan.
+                    # Remaining chunk sizes stay valid (they are step
+                    # counts, not programs); the next step_fn call runs
+                    # the new plan's compiled loop.
+                    swapped = replan.maybe_swap(state, done)
+                    if swapped is not None:
+                        state = swapped
             return state, done
         except NumericalFault as f:
             n = rollbacks.get(f.step, 0) + 1

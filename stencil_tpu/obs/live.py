@@ -31,9 +31,12 @@ telemetry vocabulary (``obs/telemetry.py NAME_FIELDS``):
 
 - ``anomaly.detected`` — metric, step, value, band, direction;
 - ``anomaly.cleared``  — metric, step (the window re-arms);
-- ``replan.requested`` — fired on every detection (default behavior is
-  record + log; the actual plan hot-swap is ROADMAP #6's follow-up —
-  the ``on_replan`` callback is the hook it will attach to).
+- ``replan.requested`` — fired on every detection. The ``on_replan``
+  callback is where the mid-run plan hot-swap attaches
+  (``plan/replan.ReplanController.request`` — the guarded loop finishes
+  the current chunk, re-probes the autotuner, and installs the winning
+  compiled plan, emitting ``replan.applied``/``replan.rejected``);
+  without a hook the default stays record + log.
 
 Fed by ``fault/recover.run_guarded`` (per-chunk step latencies) and the
 campaign driver; surfaced by ``obs/status.py`` snapshots and as
@@ -225,9 +228,10 @@ class LiveSentinel:
 
     Every detection also emits ``replan.requested`` (unless
     ``replan=False``) and invokes ``on_replan(event)`` when given — the
-    hook mid-campaign plan hot-swapping (ROADMAP #6) will consume; the
-    default is record + log, never an exception (a broken replan hook
-    must not kill the measurement).
+    mid-run plan hot-swap's trigger (``plan/replan.ReplanController``
+    latches the request here and performs the swap between guarded-loop
+    chunks); the default is record + log, never an exception (a broken
+    replan hook must not kill the measurement).
     """
 
     _KNOBS = ("window", "min_history", "mad_k", "rel_tol", "abs_tol",
@@ -241,6 +245,10 @@ class LiveSentinel:
         self.replan = bool(replan)
         self.on_replan = on_replan
         self.windows: Dict[str, OnlineWindow] = {}
+        # detect/clear history of windows dropped by reset() — run
+        # totals must survive a plan hot-swap's window reset
+        self._retired_detected = 0
+        self._retired_cleared = 0
 
     def _recorder(self):
         if self._rec is not None:
@@ -278,8 +286,10 @@ class LiveSentinel:
             if self.replan:
                 rec.meta(REPLAN_REQUESTED, reason=f"anomaly:{key}",
                          step=ev["step"], metric=key, phase="live")
-                log.warn(f"live: replan requested (anomaly in {key}; "
-                         "hot-swap is a follow-up — recorded only)")
+                log.warn(f"live: replan requested (anomaly in {key}"
+                         + ("; hot-swap hook attached)"
+                            if self.on_replan is not None
+                            else "; no hot-swap hook — recorded only)"))
                 if self.on_replan is not None:
                     try:
                         self.on_replan(dict(ev))
@@ -293,14 +303,34 @@ class LiveSentinel:
                      f"(open since step {ev['since_step']})")
         return ev
 
+    def reset(self, key: Optional[str] = None) -> None:
+        """Drop the window(s) — ALL of them, or one key's — so judgment
+        restarts from warmup. The plan hot-swap calls this after
+        ``replan.applied``: the old window's band describes the OLD
+        compiled plan's latencies, and judging the new plan (plus its
+        one-time swap-compile spike) against it would re-trip the
+        sentinel on the first post-swap chunk. Detected/cleared totals
+        are preserved — they are run history, not window state."""
+        doomed = (list(self.windows.values()) if key is None
+                  else [w for k, w in self.windows.items() if k == key])
+        for w in doomed:
+            self._retired_detected += w.detected
+            self._retired_cleared += w.cleared
+        if key is None:
+            self.windows.clear()
+        else:
+            self.windows.pop(key, None)
+
     # -- state for status snapshots -------------------------------------------
     @property
     def detected_total(self) -> int:
-        return sum(w.detected for w in self.windows.values())
+        return (self._retired_detected
+                + sum(w.detected for w in self.windows.values()))
 
     @property
     def cleared_total(self) -> int:
-        return sum(w.cleared for w in self.windows.values())
+        return (self._retired_cleared
+                + sum(w.cleared for w in self.windows.values()))
 
     def active(self) -> List[dict]:
         return [dict(w.active) for w in self.windows.values()

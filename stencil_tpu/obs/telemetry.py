@@ -87,6 +87,13 @@ NAME_FIELDS = {
     "anomaly.cleared": (("metric", str), ("step", int)),
     "slo.violation": (("tenant", str), ("step", int)),
     "replan.requested": (("reason", str), ("step", int)),
+    # the hot-swap half of ROADMAP #6 (plan/replan.ReplanController):
+    # a mid-run replan either installs a new compiled plan (applied —
+    # old/new choice labels + the static model's predicted gain rides
+    # as an optional modeled_gain tag) or degrades loudly onto the old
+    # one (rejected — a throwing autotuner/apply must never kill a run)
+    "replan.applied": (("old", str), ("new", str), ("step", int)),
+    "replan.rejected": (("reason", str), ("step", int)),
     # the fused compute+exchange vocabulary (ops/fused_stencil +
     # the host-orchestrated fused loops in ops/jacobi /
     # astaroth/integrate): the overlap split of one fused substep —
@@ -152,7 +159,10 @@ KNOWN_NAMES = frozenset(NAME_FIELDS) | frozenset({
     "pingpong.gb_per_s", "pingpong.latency_us",
     "plan.autotune", "plan.cache_hit", "plan.candidates", "plan.chosen",
     "plan.probe", "plan.probe_trimean_s", "plan.probes_run",
-    "qap.cost", "qap.solve_s",
+    # the placement leg (bench_qap --derived + the plan hot-swap): QAP
+    # solver wall/cost rows, the derived-matrix placement cost, and the
+    # modeled identity-over-placed improvement ratio
+    "qap.cost", "qap.improvement", "qap.placement_cost", "qap.solve_s",
     "recover.backoff_s",
     "wire_ab.bytes_ratio", "wire_ab.max_abs_err", "wire_ab.max_rel_err",
     "wire_ab.max_ulp_err",

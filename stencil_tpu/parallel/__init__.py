@@ -3,8 +3,11 @@ from .mesh import (
     grid_mesh, mesh_dim,
 )
 from .exchange import Method, HaloExchange, direction_bytes
-from .placement import IntraNodeRandom, NodeAware, Placement, Trivial, comm_matrix
-from .topology import Boundary, Topology
+from .placement import (
+    FixedAssignment, IntraNodeRandom, NodeAware, Placement, Trivial,
+    comm_matrix,
+)
+from .topology import Boundary, Topology, link_cost_matrix
 
 __all__ = [
     "AXIS_X",
@@ -12,6 +15,7 @@ __all__ = [
     "AXIS_Z",
     "BLOCK_PSPEC",
     "Boundary",
+    "FixedAssignment",
     "HaloExchange",
     "IntraNodeRandom",
     "MESH_AXES",
@@ -24,5 +28,6 @@ __all__ = [
     "comm_matrix",
     "direction_bytes",
     "grid_mesh",
+    "link_cost_matrix",
     "mesh_dim",
 ]

@@ -98,6 +98,29 @@ def comm_matrix(spec) -> np.ndarray:
     return m
 
 
+class FixedAssignment(Placement):
+    """An explicit, pre-solved block→device assignment — the strategy
+    form of a ``PlanChoice.placement`` tuple: grid position i (row-major
+    z, y, x) is hosted by ``devices[assignment[i]]``. What the plan
+    probes and the placed bench legs arrange with (the tuned assignment
+    must realize EXACTLY, not be re-solved)."""
+
+    def __init__(self, assignment):
+        self.assignment = tuple(int(v) for v in assignment)
+        if sorted(self.assignment) != list(range(len(self.assignment))):
+            raise ValueError(
+                f"assignment {self.assignment} is not a permutation of "
+                f"range({len(self.assignment)})")
+
+    def arrange(self, devices: Sequence, spec) -> List:
+        if len(devices) != len(self.assignment):
+            raise ValueError(
+                f"assignment covers {len(self.assignment)} devices; "
+                f"got {len(devices)}")
+        return [devices[self.assignment[i]]
+                for i in range(len(self.assignment))]
+
+
 class NodeAware(Placement):
     """QAP-matched placement: assign subdomains to devices so that heavy
     halo traffic rides the fastest links (reference: partition.hpp:525-831,

@@ -32,6 +32,7 @@ from .ir import (
     PlanConfig,
     RemoteDmaPhaseIR,
     build_plan,
+    validate_placement,
 )
 
 __all__ = [
@@ -42,4 +43,5 @@ __all__ = [
     "PlanConfig",
     "RemoteDmaPhaseIR",
     "build_plan",
+    "validate_placement",
 ]

@@ -71,6 +71,7 @@ def autotune(
     ks: Sequence[int] = (1,),
     variants: Sequence[Optional[str]] = DEFAULT_VARIANTS,
     calibration: Optional[dict] = None,
+    link_costs=None,
     rec=None,
 ) -> AutotuneResult:
     """Choose (and persist) the exchange plan for one config.
@@ -78,7 +79,16 @@ def autotune(
     ``probe=False`` keeps the run static-only (no compiles — usable
     backend-less); ``force=True`` re-tunes through an existing DB entry
     (the entry is replaced). A corrupt DB degrades loudly: the tuning
-    still runs, but nothing is persisted over the damaged file."""
+    still runs, but nothing is persisted over the damaged file.
+
+    ``link_costs`` feeds the topology-aware placement search (an ndev x
+    ndev device-pair distance matrix; ``plan/cost.enumerate_candidates``
+    grows each partition's QAP-solved placement candidate from it and
+    ``score`` prices wire time through it). When omitted and live
+    ``devices`` were given, it is derived from them
+    (``parallel/topology.link_cost_matrix`` — ICI hops on TPU, process
+    boundaries elsewhere); a uniform matrix (the single-process CPU
+    mesh) changes nothing."""
     import importlib
 
     from ..obs import telemetry
@@ -97,6 +107,10 @@ def autotune(
         ndev = ndev if ndev is not None else len(devs)
         platform = platform or devs[0].platform
     config = PlanConfig.make(size, radius, dtypes, ndev, platform)
+    if link_costs is None and devices is not None:
+        from ..parallel.topology import link_cost_matrix
+
+        link_costs = link_cost_matrix(devices)
 
     db = None
     db_ok = False
@@ -124,8 +138,10 @@ def autotune(
 
     with rec.span("plan.autotune", phase="plan"):
         candidates = enumerate_candidates(config, methods=methods,
-                                          ks=ks, variants=variants)
-        ranked = rank(config, candidates, calibration)
+                                          ks=ks, variants=variants,
+                                          link_costs=link_costs)
+        ranked = rank(config, candidates, calibration,
+                      link_costs=link_costs)
         if not ranked:
             raise ValueError(
                 f"no feasible exchange plan for {config.key()} — grid too "

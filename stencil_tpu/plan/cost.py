@@ -38,7 +38,7 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..domain.grid import GridSpec
-from ..geometry import Dim3, Radius, stack_residents
+from ..geometry import DIRECTIONS_26, Dim3, Radius, halo_extent, stack_residents
 from .ir import (
     AUTO_SPMD,
     AXIS_COMPOSED,
@@ -49,6 +49,7 @@ from .ir import (
     PlanChoice,
     PlanConfig,
     build_plan,
+    validate_placement,
 )
 
 # Calibration provenance: BASELINE.md rounds 7/10 (see module docstring).
@@ -133,6 +134,157 @@ def scale_radius(radius: Radius, k: int) -> Radius:
     return out
 
 
+# -- topology-aware placement (the reference's NodeAware/qap::solve leg) ------
+#
+# The reference's L3 places blocks by measured inter-GPU bandwidth: a QAP
+# over (communication volume x link distance) decides which physical
+# device hosts which subdomain (qap.hpp, partition.hpp:525-831). Here the
+# same leg is a PlanChoice dimension: the wire-volume matrix between MESH
+# positions falls out of the same halo_extent geometry the ExchangePlan
+# IR's wire_bytes model prices, the link-cost matrix comes from the
+# device objects (parallel/topology.link_cost_matrix — ICI hop distance
+# on TPU, process-boundary penalty elsewhere), and the product prices a
+# placement relative to identity.
+
+
+def placement_wire_matrix(spec: GridSpec, mesh_dim,
+                          per_cell_bytes: int = 1):
+    """Pairwise wire-volume matrix between MESH positions (row-major
+    z, y, x — the same linearization the placement assignment uses).
+
+    Built from the exact halo_extent geometry the IR's ``wire_cells``
+    model prices: every active direction's halo slab of every block,
+    attributed to the (sender-slot, receiver-slot) pair, with self-wrap
+    and resident-internal (same-device) traffic excluded — those never
+    touch the interconnect, so a placement cannot change their cost
+    (the reference's comm matrix, partition.hpp:722-752, aggregated to
+    device granularity). Pure geometry, jax-free."""
+    import numpy as np
+
+    md = Dim3.of(mesh_dim)
+    if spec.dim.x % md.x or spec.dim.y % md.y or spec.dim.z % md.z:
+        raise ValueError(f"mesh {md} does not divide partition {spec.dim}")
+    c = Dim3(spec.dim.x // md.x, spec.dim.y // md.y, spec.dim.z // md.z)
+    n = md.flatten()
+    m = np.zeros((n, n), dtype=np.float64)
+
+    def slot(b: Dim3) -> int:
+        return (b.x // c.x) + (b.y // c.y) * md.x + (b.z // c.z) * md.x * md.y
+
+    for iz in range(spec.dim.z):
+        for iy in range(spec.dim.y):
+            for ix in range(spec.dim.x):
+                src = Dim3(ix, iy, iz)
+                sz = spec.block_size(src)
+                for d in DIRECTIONS_26:
+                    # send-extent rule: data toward d fills the receiver's
+                    # -d halo, active iff radius.dir(-d) != 0
+                    if spec.radius.dir(-d) == 0:
+                        continue
+                    dst = (src + d).wrap(spec.dim)
+                    if dst == src:
+                        continue  # self-wrap: no inter-device traffic
+                    ss, ds = slot(src), slot(dst)
+                    if ss == ds:
+                        continue  # resident neighbors: local shifts
+                    m[ss, ds] += (halo_extent(-d, sz, spec.radius).flatten()
+                                  * per_cell_bytes)
+    return m
+
+
+# rank() scores every (method x batching x k x variant) candidate of a
+# partition, and each placed one needs the SAME wire matrix — a pure-
+# Python O(blocks x 26) halo_extent sweep that must not be rebuilt per
+# candidate (nor per between-chunk replan retune). Bounded: the key
+# space is tiny (partitions of one tuning pass) but a long-lived service
+# retuning many configs must not grow without bound.
+_WIRE_MATRIX_CACHE: Dict[Tuple, object] = {}
+_WIRE_MATRIX_CACHE_MAX = 128
+
+
+def _cached_wire_matrix(spec: GridSpec, mesh_dim, config: PlanConfig,
+                        multistep_k: int):
+    key = (config.grid, config.radius, int(multistep_k),
+           (spec.dim.x, spec.dim.y, spec.dim.z),
+           (mesh_dim.x, mesh_dim.y, mesh_dim.z))
+    w = _WIRE_MATRIX_CACHE.get(key)
+    if w is None:
+        if len(_WIRE_MATRIX_CACHE) >= _WIRE_MATRIX_CACHE_MAX:
+            _WIRE_MATRIX_CACHE.clear()
+        w = _WIRE_MATRIX_CACHE[key] = placement_wire_matrix(spec, mesh_dim)
+    return w
+
+
+def placement_cost(w, link_costs, placement=None) -> float:
+    """Assignment cost ``sum_ab w[a,b] * link[f[a],f[b]]`` with the
+    reference's ``0 * inf == 0`` rule (qap.hpp cost_product) — pinned
+    equal to ``parallel.qap.cost`` by tests/test_plan_placement.py but
+    implemented here so the jax-free cost model never imports the
+    parallel package. ``placement=None`` is the identity assignment."""
+    import numpy as np
+
+    w = np.asarray(w, dtype=np.float64)
+    d = np.asarray(link_costs, dtype=np.float64)
+    n = w.shape[0]
+    f = np.arange(n) if placement is None else np.asarray(placement,
+                                                          dtype=np.intp)
+    dperm = d[np.ix_(f, f)]
+    prod = w * dperm
+    prod[(w == 0) | (dperm == 0)] = 0.0
+    return float(prod.sum())
+
+
+def uniform_link_costs(link_costs) -> bool:
+    """True when every off-diagonal link costs the same — placement is
+    then cost-neutral and the QAP search is skipped (identity optimal)."""
+    import numpy as np
+
+    d = np.asarray(link_costs, dtype=np.float64)
+    n = d.shape[0]
+    if n < 2:
+        return True
+    off = d[~np.eye(n, dtype=bool)]
+    return bool(np.all(off == off[0]))
+
+
+# Exhaustive-search size limit for the placement QAP: at n <= 6 the full
+# 720-permutation sweep completes in milliseconds even in pure Python, so
+# the answer is deterministic and budget-independent; beyond it the
+# greedy best-pairwise-swap descent (qap.hpp:87-180) runs instead — a
+# timed-out partial exhaustive search would make the tuned plan depend on
+# host load, which a persisted DB entry must never do.
+PLACEMENT_EXACT_LIMIT = 6
+
+
+def solve_placement(w, link_costs,
+                    exact_limit: int = PLACEMENT_EXACT_LIMIT,
+                    timeout_s: float = 10.0) -> Optional[Tuple[int, ...]]:
+    """The QAP-optimal placement for (wire volumes, link costs), or None
+    when identity is already (modeled) optimal — uniform links included.
+    Dispatches to ``parallel.qap``: exhaustive ``solve`` at small n,
+    greedy ``solve_catch`` beyond (see :data:`PLACEMENT_EXACT_LIMIT`).
+    Imported lazily — the solvers are numpy-only but live in the
+    parallel package; static-only callers that never search placements
+    (plan_tool explain) stay jax-free."""
+    import numpy as np
+
+    if uniform_link_costs(link_costs):
+        return None
+    from ..parallel import qap
+
+    w = np.asarray(w, dtype=np.float64)
+    d = np.asarray(link_costs, dtype=np.float64)
+    n = w.shape[0]
+    if n <= exact_limit:
+        f, cost = qap.solve(w, d, timeout_s=timeout_s)
+    else:
+        f, cost = qap.solve_catch(w, d)
+    identity = placement_cost(w, d)
+    if f == list(range(n)) or cost >= identity:
+        return None  # identity is optimal (or the solver found nothing better)
+    return tuple(f)
+
+
 def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
     """(spec, mesh_dim, resident) when the candidate can realize on this
     config, else None. Mirrors realize()'s constraints exactly: the
@@ -141,7 +293,11 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
     the effective radius. The fused compute+exchange variant is a
     REMOTE_DMA-only, single-resident lowering — any other combination is
     infeasible here (the loud-infeasibility contract: realize() raises
-    the same constraints)."""
+    the same constraints). A ``placement`` must be a permutation of the
+    config's ``ndev`` mesh positions (plan/ir.validate_placement — the
+    same check realize() raises on)."""
+    if validate_placement(choice.placement, config.ndev) is not None:
+        return None
     if choice.kernel_variant == FUSED_VARIANT:
         if choice.method != REMOTE_DMA:
             return None
@@ -186,13 +342,24 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
 
 
 def score(config: PlanConfig, choice: PlanChoice,
-          calibration: Optional[dict] = None) -> Optional[PlanCost]:
+          calibration: Optional[dict] = None,
+          link_costs=None) -> Optional[PlanCost]:
     """Static per-step cost of one candidate (None when infeasible).
 
     The score is a function of the dtype MULTISET only — a config whose
     quantity list is a permutation of another's scores identically, so
     the ranking is invariant under quantity-dtype permutation
-    (tests/test_plan_cost.py pins this)."""
+    (tests/test_plan_cost.py pins this).
+
+    ``link_costs`` (an ndev x ndev per-device-pair distance matrix —
+    parallel/topology.link_cost_matrix) prices the choice's block
+    placement: the wire term scales by the QAP cost ratio
+    ``placement_cost(w, link, f) / placement_cost(w, link, identity)``,
+    so on a mesh with non-uniform links a topology-matched placement
+    scores strictly cheaper than identity while the calibrated
+    ``wire_bytes_per_s`` keeps its identity-baseline meaning. Without
+    link costs every placement prices identically and the deterministic
+    label tie-break keeps identity first."""
     cal = dict(DEFAULT_CALIBRATION)
     for k, v in (calibration or {}).items():
         # dict-valued keys (per-method overheads, variant factors) merge
@@ -217,6 +384,15 @@ def score(config: PlanConfig, choice: PlanChoice,
     wire = plan.wire_bytes(itemsizes, floating=config.floating_flags())
     local = plan.local_bytes(itemsizes)
     dmas = plan.dmas_per_exchange(nq, ngroups)
+    # placement pricing: wire time scales by the QAP cost ratio vs the
+    # identity assignment (1.0 when no link costs are known, when the
+    # links are uniform, or when nothing crosses the wire)
+    pratio = 1.0
+    if link_costs is not None and choice.placement is not None and wire:
+        w = _cached_wire_matrix(spec, mesh_dim, config, choice.multistep_k)
+        base = placement_cost(w, link_costs)
+        if base > 0:
+            pratio = placement_cost(w, link_costs, choice.placement) / base
     if fused:
         # overlap-aware: the fused substep runs
         #   max(interior_compute, dma) + boundary_compute
@@ -232,7 +408,8 @@ def score(config: PlanConfig, choice: PlanChoice,
         rd = cal["remote_dma"]
         per_dma = (rd["dma_overhead_s"] if config.platform == "tpu"
                    else rd["cpu_emulation_overhead_s"])
-        wire_s = wire / rd.get("wire_bytes_per_s", cal["wire_bytes_per_s"])
+        wire_s = (wire / rd.get("wire_bytes_per_s", cal["wire_bytes_per_s"])
+                  * pratio)
         b = spec.base
         r0 = config.radius_obj()
         shrink = [
@@ -261,14 +438,15 @@ def score(config: PlanConfig, choice: PlanChoice,
                    else rd["cpu_emulation_overhead_s"])
         exchange_s = (
             dmas * per_dma
-            + wire / rd.get("wire_bytes_per_s", cal["wire_bytes_per_s"])
+            + (wire / rd.get("wire_bytes_per_s", cal["wire_bytes_per_s"])
+               * pratio)
             + local / cal["local_bytes_per_s"]
         )
     else:
         overhead = cal["permute_overhead_s"][choice.method]
         exchange_s = (
             collectives * overhead
-            + wire / cal["wire_bytes_per_s"]
+            + wire / cal["wire_bytes_per_s"] * pratio
             + local / cal["local_bytes_per_s"]
         )
     k = choice.multistep_k
@@ -327,23 +505,57 @@ def enumerate_candidates(
     ks: Iterable[int] = (1,),
     variants: Iterable[Optional[str]] = DEFAULT_VARIANTS,
     oversubscribe: Sequence[int] = (1,),
+    link_costs=None,
 ) -> List[PlanChoice]:
     """The search space: partition shape x method x quantity batching x
-    temporal depth k x kernel variant. Batching only branches when the
-    config has more than one quantity (at Q=1 the two programs are
-    identical — PR 5's degeneration contract). With the DEFAULT variant
-    set, REMOTE_DMA additionally branches on the fused compute+exchange
-    variant (kernel_variant == "fused") so the autotuner searches the
-    overlap lever out of the box; an EXPLICIT ``variants`` restriction —
-    ``(None,)`` included — is honored verbatim (the sentinel comparison
-    is by identity with :data:`DEFAULT_VARIANTS`). Infeasible fused
-    points (oversubscribed partitions) fall out at score() like every
-    other constraint."""
+    temporal depth k x kernel variant x block placement. Batching only
+    branches when the config has more than one quantity (at Q=1 the two
+    programs are identical — PR 5's degeneration contract). With the
+    DEFAULT variant set, REMOTE_DMA additionally branches on the fused
+    compute+exchange variant (kernel_variant == "fused") so the
+    autotuner searches the overlap lever out of the box; an EXPLICIT
+    ``variants`` restriction — ``(None,)`` included — is honored
+    verbatim (the sentinel comparison is by identity with
+    :data:`DEFAULT_VARIANTS`). Infeasible fused points (oversubscribed
+    partitions) fall out at score() like every other constraint.
+
+    With ``link_costs`` (non-uniform), every single-resident partition
+    additionally branches on its QAP-solved placement
+    (:func:`solve_placement` over :func:`placement_wire_matrix` — one
+    placed candidate beside identity, never the factorial permutation
+    space; the reference's NodeAware does exactly this). Uniform links
+    solve to identity and add nothing, so the CPU-mesh search space is
+    byte-identical to the pre-placement one."""
     if config.num_quantities <= 1:
         batch_options = (True,)
     default_variants = variants is DEFAULT_VARIANTS
+    placements_by_part: Dict[Tuple[int, int, int],
+                             Optional[Tuple[int, ...]]] = {}
+
+    def placed_for(part) -> Optional[Tuple[int, ...]]:
+        if link_costs is None:
+            return None
+        if part not in placements_by_part:
+            placements_by_part[part] = None
+            feas = feasible(config, PlanChoice(partition=part,
+                                               method=AXIS_COMPOSED))
+            if feas is not None:
+                spec, mesh_dim, resident = feas
+                if resident == Dim3(1, 1, 1):
+                    # single-resident only: the placement permutes mesh
+                    # positions, and probing an oversubscribed placed
+                    # mesh is a follow-up (the search default does not
+                    # oversubscribe anyway)
+                    w = _cached_wire_matrix(spec, mesh_dim, config, 1)
+                    placements_by_part[part] = solve_placement(w, link_costs)
+        return placements_by_part[part]
+
     out = []
     for part in candidate_partitions(config, oversubscribe):
+        placements: Tuple[Optional[Tuple[int, ...]], ...] = (None,)
+        placed = placed_for(part)
+        if placed is not None:
+            placements = (None, placed)
         for method in methods:
             vlist = list(variants)
             if (method == REMOTE_DMA and default_variants
@@ -352,22 +564,27 @@ def enumerate_candidates(
             for batch in batch_options:
                 for k in ks:
                     for variant in vlist:
-                        out.append(PlanChoice(
-                            partition=part, method=method,
-                            batch_quantities=batch, multistep_k=k,
-                            kernel_variant=variant,
-                        ))
+                        for placement in placements:
+                            out.append(PlanChoice(
+                                partition=part, method=method,
+                                batch_quantities=batch, multistep_k=k,
+                                kernel_variant=variant,
+                                placement=placement,
+                            ))
     return out
 
 
 def rank(config: PlanConfig, candidates: Iterable[PlanChoice],
-         calibration: Optional[dict] = None) -> List[Tuple[PlanCost, PlanChoice]]:
+         calibration: Optional[dict] = None,
+         link_costs=None) -> List[Tuple[PlanCost, PlanChoice]]:
     """Feasible candidates sorted cheapest-first. Ties break on the
     choice label so the order is total and deterministic (the
-    permutation-invariance property needs a stable ranking)."""
+    permutation-invariance property needs a stable ranking; an identity
+    placement's label is a strict prefix of its placed sibling's, so
+    identity wins exact ties — placement must EARN its slot)."""
     scored = []
     for choice in candidates:
-        c = score(config, choice, calibration)
+        c = score(config, choice, calibration, link_costs=link_costs)
         if c is not None:
             scored.append((c, choice))
     scored.sort(key=lambda t: (t[0].total_s, t[1].label()))

@@ -34,7 +34,7 @@ import os
 import time
 from typing import List, Optional
 
-from .ir import METHODS, PlanChoice, PlanConfig
+from .ir import METHODS, PlanChoice, PlanConfig, validate_placement
 
 DB_VERSION = 1
 DB_KIND = "stencil-plan-db"
@@ -92,6 +92,13 @@ def validate_entry(key: str, entry) -> List[str]:
         errs.append(f"entry {key!r}: partition must be 3 positive ints")
     if choice.multistep_k < 1:
         errs.append(f"entry {key!r}: multistep_k must be >= 1")
+    # placement rides schema v1: an ABSENT field is the identity
+    # assignment (every pre-placement entry — legacy v0 migrations
+    # included — deserializes to None and replays unchanged); a present
+    # one must be a permutation of the config's mesh positions
+    perr = validate_placement(choice.placement, cfg.ndev)
+    if perr is not None:
+        errs.append(f"entry {key!r}: {perr}")
     if entry.get("source") not in SOURCES:
         errs.append(f"entry {key!r}: unknown source {entry.get('source')!r}")
     for fld in ("static_cost_s", "measured_s"):

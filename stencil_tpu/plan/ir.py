@@ -772,17 +772,46 @@ class PlanConfig:
         )
 
 
+def validate_placement(placement, ndev: int) -> Optional[str]:
+    """The one placement-shape authority: ``None`` (identity) or a
+    permutation of ``range(ndev)`` mapping mesh position i (row-major
+    z, y, x over the mesh grid) to the index of the device that hosts it
+    in the original device list — the reference's ``qap::solve``
+    assignment vector. Returns an error string, or None when valid."""
+    if placement is None:
+        return None
+    try:
+        f = [int(v) for v in placement]
+    except (TypeError, ValueError):
+        return f"placement must be a sequence of ints, got {placement!r}"
+    if len(f) != ndev:
+        return (f"placement has {len(f)} entries for {ndev} mesh "
+                "positions")
+    if sorted(f) != list(range(ndev)):
+        return f"placement {f} is not a permutation of range({ndev})"
+    return None
+
+
 @dataclass(frozen=True)
 class PlanChoice:
     """One point in the search space — what the autotuner picks and the
     DB persists: partition shape x exchange method x quantity batching x
-    temporal depth k x kernel variant."""
+    temporal depth k x kernel variant x block placement.
+
+    ``placement`` is the topology-aware block→device assignment
+    (reference: ``NodeAware``/``qap::solve``): ``placement[i]`` is the
+    index (into the original device list) of the device hosting mesh
+    position i, row-major (z, y, x) over the mesh grid. ``None`` is the
+    identity assignment — the historical block order = device order —
+    and is what every pre-placement DB entry deserializes to (the
+    schema-migration default: an absent field IS identity)."""
 
     partition: Tuple[int, int, int]   # blocks (x, y, z)
     method: str                       # METHODS value string
     batch_quantities: bool = True
     multistep_k: int = 1
     kernel_variant: Optional[str] = None
+    placement: Optional[Tuple[int, ...]] = None
 
     def to_json(self) -> dict:
         return {
@@ -791,22 +820,33 @@ class PlanChoice:
             "batch_quantities": self.batch_quantities,
             "multistep_k": self.multistep_k,
             "kernel_variant": self.kernel_variant,
+            "placement": (None if self.placement is None
+                          else list(self.placement)),
         }
 
     @classmethod
     def from_json(cls, obj: dict) -> "PlanChoice":
+        placement = obj.get("placement")
         return cls(
             partition=tuple(obj["partition"]),
             method=str(obj["method"]),
             batch_quantities=bool(obj.get("batch_quantities", True)),
             multistep_k=int(obj.get("multistep_k", 1)),
             kernel_variant=obj.get("kernel_variant"),
+            placement=(None if placement is None
+                       else tuple(int(v) for v in placement)),
         )
 
     @property
     def is_fused(self) -> bool:
         """The fused compute+exchange mega-kernel variant of REMOTE_DMA."""
         return self.kernel_variant == FUSED_VARIANT
+
+    @property
+    def is_placed(self) -> bool:
+        """True when the choice carries a non-identity block placement."""
+        return (self.placement is not None
+                and list(self.placement) != list(range(len(self.placement))))
 
     def label(self) -> str:
         px, py, pz = self.partition
@@ -816,4 +856,6 @@ class PlanChoice:
             s += f"/k={self.multistep_k}"
         if self.kernel_variant:
             s += f"/{self.kernel_variant}"
+        if self.is_placed:
+            s += "/p=" + "-".join(str(v) for v in self.placement)
         return s

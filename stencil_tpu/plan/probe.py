@@ -61,6 +61,9 @@ def probe_choice(config: PlanConfig, choice: PlanChoice,
             batch_quantities=choice.batch_quantities,
             partition=choice.partition,
             fused=choice.is_fused,
+            # a placed candidate probes on its placed mesh — the tuned
+            # assignment must be what the measurement measured
+            placement=choice.placement,
         )
     trimean = r["trimean_s"]
     rec.gauge("plan.probe_trimean_s", trimean, phase="plan", unit="s",

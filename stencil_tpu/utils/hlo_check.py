@@ -222,6 +222,27 @@ def collective_census(hlo_text: str) -> Dict[str, Tuple[int, int]]:
     return out
 
 
+def collective_permute_pairs(hlo_text: str):
+    """Every ``collective-permute``'s ``source_target_pairs``, one
+    frozenset of (src, tgt) logical-device pairs per op instance, in
+    module order — the placement-conformance auditor's raw material
+    (analysis/verify_plan): logical ids index the computation's device
+    assignment, i.e. the mesh's device order, so mapping a pair through
+    ``mesh.devices.flatten()`` yields the physical link it rides."""
+    out = []
+    for ln in hlo_text.splitlines():
+        m = _COLLECTIVE_OP_RE.search(ln)
+        if not m or m.group(1) != "collective-permute":
+            continue
+        pm = _PAIR_RE.search(ln)
+        if not pm:
+            out.append(frozenset())
+            continue
+        pairs = re.findall(r"\{(\d+),(\d+)\}", pm.group(1))
+        out.append(frozenset((int(a), int(b)) for a, b in pairs))
+    return out
+
+
 _STABLEHLO_OP_RE = re.compile(
     r'"stablehlo\.(collective_permute|all_gather|all_reduce|all_to_all|'
     r'reduce_scatter|collective_broadcast)"'
