@@ -284,6 +284,65 @@ def _child_main(mode: str, resume: bool = False) -> int:
         except Exception as e:
             errors["jacobi_fused"] = f"{type(e).__name__}: {e}"[:400]
 
+    # persistent whole-chunk jacobi (ROADMAP #7): the communication-
+    # avoiding temporal-fusion variant — ONE deep (radius*k) exchange +
+    # ONE k-substep chunk program per chunk, 2 dispatches per chunk
+    # instead of 2k — vs the per-step fused kernel at 32^3 and 64^3 on
+    # the 8-device mesh. Same CPU-emulation caveat as the fused leg: on
+    # the CPU child both legs are host-orchestrated, the ratio prices
+    # host dispatch amortization (which IS the lever the variant pulls),
+    # and only the TPU mega-kernel number (scripts/probe_persistent.py,
+    # item-1 session) carries the launch-count hardware claim. Ledger
+    # ingest auto-appends both sizes via STENCIL_BENCH_LEDGER.
+    jac_pers = {}
+    if leg("jacobi persistent-over-fused (32^3/64^3, 8-dev)"):
+        try:
+            import jax.numpy as jnp
+
+            from stencil_tpu.ops.jacobi import (INIT_TEMP, make_jacobi_loop,
+                                                sphere_sel)
+
+            ndevp_ = 8 if len(jax.devices()) >= 8 else 1
+            dimp_ = Dim3(2, 2, 2) if ndevp_ == 8 else Dim3(1, 1, 1)
+            kp = 2
+
+            def pers_leg(nb: int, persistent: bool) -> float:
+                spec_ = GridSpec(Dim3(nb, nb, nb), dimp_,
+                                 Radius.constant(kp if persistent else 1))
+                mesh_ = grid_mesh(spec_.dim, jax.devices()[:ndevp_])
+                ex = HaloExchange(spec_, mesh_, Method.REMOTE_DMA,
+                                  persistent=persistent,
+                                  fused=not persistent)
+                sub_iters = 4
+                loop = make_jacobi_loop(
+                    ex, sub_iters,
+                    temporal_k=kp if persistent else None)
+                sel_ = shard_blocks(sphere_sel((nb, nb, nb)), spec_, mesh_)
+                c = shard_blocks(
+                    np.full((nb,) * 3, INIT_TEMP, np.float32), spec_, mesh_)
+                nx_ = jax.device_put(jnp.zeros_like(c), ex.sharding())
+                c, nx_ = loop(c, nx_, sel_)  # compile + warm
+                hard_sync((c, nx_))
+                st = Statistics()
+                for _ in range(2):
+                    t1 = time.perf_counter()
+                    c, nx_ = loop(c, nx_, sel_)
+                    hard_sync((c, nx_))
+                    st.insert((time.perf_counter() - t1) / sub_iters)
+                return nb ** 3 / st.trimean() / 1e6
+
+            for nb_ in (32, 64):
+                jac_pers[f"jacobi_persistent_mcells_per_s_{nb_}"] = round(
+                    pers_leg(nb_, True), 2)
+                jac_pers[f"jacobi_fused_base_mcells_per_s_{nb_}"] = round(
+                    pers_leg(nb_, False), 2)
+                base_ = jac_pers[f"jacobi_fused_base_mcells_per_s_{nb_}"]
+                jac_pers[f"jacobi_persistent_over_fused_{nb_}"] = (
+                    round(jac_pers[f"jacobi_persistent_mcells_per_s_{nb_}"]
+                          / base_, 3) if base_ else 0.0)
+        except Exception as e:
+            errors["jacobi_persistent"] = f"{type(e).__name__}: {e}"[:400]
+
     # quantity-batching A/B at Q=8 (the astaroth field count): one packed
     # ppermute carrier per axis phase vs one collective per quantity. On an
     # 8-device mesh (the CPU child forces 8 virtual devices) the partition
@@ -494,6 +553,11 @@ def _child_main(mode: str, resume: bool = False) -> int:
         "jacobi_fused_over_remote_dma": (
             round(jac_fused_mc / jac_rd_mc, 3) if jac_rd_mc else 0.0
         ),
+        # persistent whole-chunk variant over the per-step fused kernel
+        # at 32^3 and 64^3 (> 1 means paying 2 dispatches per k-step
+        # chunk beat 2 per step; the tracked jacobi_persistent_over_
+        # fused_{32,64} legs — CPU A/B here, TPU in the item-1 session)
+        **jac_pers,
         # quantity-batching leg (Q=8, the astaroth field count): batched
         # packed-carrier exchange over the per-quantity program
         # (> 1 means one-collective-per-phase wins)

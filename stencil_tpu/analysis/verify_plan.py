@@ -21,7 +21,13 @@ and cross-checks the IR's predictions against the compiled truth:
 - no collective kind beyond ``collective-permute`` ever appears;
 - for REMOTE_DMA, the emulated per-neighbor transfer count equals
   ``dmas_per_exchange x ndev`` (each device issues the plan's per-device
-  copies) and the census carries zero collective bytes.
+  copies) and the census carries zero collective bytes;
+- for the persistent whole-chunk variant (``remote-dma+persistent``,
+  audited at chunk depth k = 2 with the radius*k deep halo), one real
+  chunk additionally runs through the persistent loop and the MEASURED
+  ``last_launches_per_chunk`` must equal the plan's
+  ``launches_per_chunk(k)`` prediction — the launch-count census the
+  cost model prices and the CI gate pins.
 
 One schema-valid JSON verdict per config (``analysis.plan_verdict``
 records through obs/telemetry when a recorder is attached; the same
@@ -87,6 +93,16 @@ class Verdict:
 # predictions to conform to).
 FUSED_METHOD_LABEL = "remote-dma+fused"
 
+# The persistent whole-chunk variant is the sixth label: method
+# remote-dma with kernel_variant=persistent at multistep_k=2 (the
+# minimum chunk depth — the spec realizes radius*2 halos through
+# plan/cost.feasible exactly as realize() would). Beyond the shared
+# zero-collective/DMA-count checks, its audit runs one real chunk loop
+# and cross-checks the MEASURED ``ex.last_launches_per_chunk`` against
+# the plan's ``launches_per_chunk(k)`` prediction — the launch census
+# as a conformance-audited prediction, not just a telemetry gauge.
+PERSISTENT_METHOD_LABEL = "remote-dma+persistent"
+
 
 def sweep_configs(
     size: int = DEFAULT_SIZE,
@@ -97,10 +113,11 @@ def sweep_configs(
 ) -> List[dict]:
     """The sweep grid as plain dicts (label, size, radius, partition,
     method, dtypes). Default methods: every ``plan.ir.METHODS`` entry
-    PLUS the fused variant label ``remote-dma+fused``."""
+    PLUS the variant labels ``remote-dma+fused`` and
+    ``remote-dma+persistent``."""
     from ..plan.ir import METHODS
 
-    known = tuple(METHODS) + (FUSED_METHOD_LABEL,)
+    known = tuple(METHODS) + (FUSED_METHOD_LABEL, PERSISTENT_METHOD_LABEL)
     methods = list(methods or known)
     unknown = sorted(set(methods) - set(known))
     if unknown:
@@ -147,12 +164,14 @@ def audit_config(cfg: dict, devices=None,
     from ..parallel import HaloExchange, Method, grid_mesh
     from ..parallel.exchange import shard_blocks
     from ..plan.cost import feasible
-    from ..plan.ir import FUSED_VARIANT, PlanChoice, PlanConfig, REMOTE_DMA
+    from ..plan.ir import (FUSED_VARIANT, PERSISTENT_VARIANT, PlanChoice,
+                           PlanConfig, REMOTE_DMA)
 
     devices = list(devices) if devices is not None else jax.devices()
     v = Verdict(label=cfg["label"], method=cfg["method"])
     fused = cfg["method"] == FUSED_METHOD_LABEL
-    method = REMOTE_DMA if fused else cfg["method"]
+    persistent = cfg["method"] == PERSISTENT_METHOD_LABEL
+    method = REMOTE_DMA if (fused or persistent) else cfg["method"]
     size, dtypes = cfg["size"], list(cfg["dtypes"])
     import numpy as np
 
@@ -168,8 +187,14 @@ def audit_config(cfg: dict, devices=None,
         return v
     config = PlanConfig.make(Dim3(size, size, size), radius, dtypes,
                              nblocks, devices[0].platform)
-    choice = PlanChoice(partition=cfg["partition"], method=method,
-                        kernel_variant=FUSED_VARIANT if fused else None)
+    choice = PlanChoice(
+        partition=cfg["partition"], method=method,
+        kernel_variant=(PERSISTENT_VARIANT if persistent
+                        else FUSED_VARIANT if fused else None),
+        # persistent IS temporal fusion: k=2 is its minimum depth, and
+        # feasible() scales the realized radius to radius*k — the deep
+        # halo the audited exchange actually stages
+        multistep_k=2 if persistent else 1)
     feas = feasible(config, choice)
     if feas is None:
         v.skipped = True
@@ -180,7 +205,8 @@ def audit_config(cfg: dict, devices=None,
         return v
     spec, mesh_dim, _resident = feas
     mesh = grid_mesh(spec.dim, devices[:nblocks])
-    ex = HaloExchange(spec, mesh, Method(method), fused=fused)
+    ex = HaloExchange(spec, mesh, Method(method), fused=fused,
+                      persistent=persistent)
     g = spec.global_size
     base = np.arange(g.x * g.y * g.z, dtype=np.float64).reshape(
         g.z, g.y, g.x)
@@ -218,6 +244,24 @@ def audit_config(cfg: dict, devices=None,
         actual_transfers = ex._remote.last_transfer_count
         ok &= _check(v.checks, "dma_transfers",
                      predicted_dmas * nblocks, actual_transfers)
+        if persistent:
+            # the launch census as a conformance-audited PREDICTION:
+            # run one real k=2 chunk through the persistent loop and
+            # require the measured dispatches-per-chunk to equal the
+            # plan's launches_per_chunk(k) — the figure cost.score
+            # prices and the CI gate pins
+            from ..ops.jacobi import make_jacobi_loop
+
+            import jax.numpy as jnp
+
+            loop = make_jacobi_loop(ex, 2, standard_spheres=False,
+                                    temporal_k=2)
+            sel = shard_blocks(
+                np.zeros((g.z, g.y, g.x), dtype=np.int32), spec, mesh)
+            loop(state[0], jnp.zeros_like(state[0]), sel)
+            ok &= _check(v.checks, "launches_per_chunk",
+                         plan.launches_per_chunk(2),
+                         ex.last_launches_per_chunk)
     else:
         ok &= _check(v.checks, "wire_bytes", predicted_wire, actual_bytes)
     v.ok = bool(ok)

@@ -67,6 +67,7 @@ class DistributedDomain:
         self._method = Method.AXIS_COMPOSED
         self._batch_quantities = True
         self._fused = False
+        self._persistent = False
         self._wire_dtype: Optional[str] = None
         self._devices: Optional[Sequence] = None
         self._partition_dim: Optional[Dim3] = None
@@ -161,6 +162,20 @@ class DistributedDomain:
         realize() raises loudly otherwise."""
         self._fused = bool(enabled)
 
+    def set_persistent_exchange(self, enabled: bool) -> None:
+        """The PERSISTENT whole-chunk variant of ``Method.REMOTE_DMA``
+        (ROADMAP #7, ops/persistent_stencil.py): the step driver
+        exchanges radius*k-deep halos ONCE per k-step chunk and runs the
+        k substeps with no further communication — launch count drops
+        from O(steps) to O(chunks). The domain must be realized at the
+        DEEPENED radius (radius*k) — the step drivers that own the knob
+        (``jacobi3d --kernel-variant persistent``) do this; also set
+        automatically when a tuned plan carries
+        ``kernel_variant == "persistent"``. Mutually exclusive with
+        :meth:`set_fused_exchange`; single-resident REMOTE_DMA only —
+        realize() raises loudly otherwise."""
+        self._persistent = bool(enabled)
+
     def set_quantity_batching(self, enabled: bool) -> None:
         """Quantity-batched exchange (default on): per collective, all
         same-dtype quantities' boundary slabs ride ONE packed ``(Q, ...)``
@@ -248,6 +263,7 @@ class DistributedDomain:
                     # tuned program exactly (and a composed winner must
                     # not crash realize() on a stale fused flag)
                     self._fused = ch.is_fused
+                    self._persistent = ch.is_persistent
                     if self._partition_dim is None:
                         self._partition_dim = Dim3.of(ch.partition)
             if self._partition_dim is not None:
@@ -317,6 +333,7 @@ class DistributedDomain:
                 batch_quantities=self._batch_quantities,
                 wire_dtype=self._wire_dtype,
                 fused=self._fused,
+                persistent=self._persistent,
             )
             sharding = self._exchange.sharding()
             for idx, dt in enumerate(self._dtypes):
@@ -468,7 +485,7 @@ class DistributedDomain:
         devs = self.mesh.devices.flatten()
         cfg = PlanConfig.make(self.size, self.radius, self._dtypes,
                               len(devs), devs[0].platform)
-        from .plan.ir import FUSED_VARIANT
+        from .plan.ir import FUSED_VARIANT, PERSISTENT_VARIANT
 
         ch = self._plan_choice
         choice = PlanChoice(
@@ -477,7 +494,9 @@ class DistributedDomain:
             batch_quantities=self._batch_quantities,
             multistep_k=ch.multistep_k if ch is not None else 1,
             kernel_variant=(ch.kernel_variant if ch is not None
-                            else FUSED_VARIANT if self._fused else None),
+                            else FUSED_VARIANT if self._fused
+                            else PERSISTENT_VARIANT if self._persistent
+                            else None),
             placement=ch.placement if ch is not None else None,
         )
         return {"key": cfg.to_json(), "choice": choice.to_json(),

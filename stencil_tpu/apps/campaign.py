@@ -324,13 +324,6 @@ def main(argv: Optional[list] = None) -> int:
             p.error("--replan swaps plans at slot boundaries of the "
                     "batched driver; --mode sequential has none "
                     "(use batched or ab)")
-    if args.replan and not args.plan_db:
-        # the campaign swap's APPLY is the DB install — without a DB the
-        # re-tune would persist nowhere, no slot program would ever
-        # consult it, and replan.applied would claim a swap that did
-        # nothing (the sibling misuses error loudly; so does this one)
-        p.error("--replan persists the re-tuned plan into --plan-db; "
-                "pass one (the swap would otherwise install nothing)")
         if args.status_file:
             # may come from the globally-exported STENCIL_STATUS_FILE
             # env var rather than the command line — warn + ignore
@@ -340,6 +333,13 @@ def main(argv: Optional[list] = None) -> int:
                      "ignored in --mode sequential (status snapshots "
                      "ride the guarded batched driver)")
             args.status_file = ""
+    if args.replan and not args.plan_db:
+        # the campaign swap's APPLY is the DB install — without a DB the
+        # re-tune would persist nowhere, no slot program would ever
+        # consult it, and replan.applied would claim a swap that did
+        # nothing (the sibling misuses error loudly; so does this one)
+        p.error("--replan persists the re-tuned plan into --plan-db; "
+                "pass one (the swap would otherwise install nothing)")
     from ._bench_common import canonicalize_live_config
     try:
         canonicalize_live_config(args)

@@ -80,11 +80,27 @@ def run(
     inject: Optional[str] = None,
     wire_dtype: Optional[str] = None,
     fused: bool = False,
+    kernel_variant: Optional[str] = None,
     sentinel=None,
     status=None,
     replan: bool = False,
     replan_probe: bool = False,
 ) -> dict:
+    # kernel_variant is the tuned-plan vocabulary ("fused" / "persistent",
+    # plan/ir.py); --fused stays as the historical spelling of the former
+    if fused and kernel_variant is None:
+        kernel_variant = "fused"
+    if kernel_variant == "fused":
+        fused = True
+    elif kernel_variant == "persistent" and deep_halo < 2:
+        raise ValueError(
+            "kernel_variant='persistent' is the whole-chunk temporal "
+            "fusion: it needs --deep-halo >= 2 (the chunk depth k; the "
+            "domain realizes radius*k halos)")
+    elif kernel_variant not in (None, "fused", "persistent"):
+        raise ValueError(
+            f"unknown kernel_variant {kernel_variant!r}: valid values are "
+            "'fused' and 'persistent'")
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
     if (weak and n > 1 and partition is None
@@ -141,6 +157,11 @@ def run(
         # the fused compute+exchange variant (REMOTE_DMA only —
         # DistributedDomain validates loudly at realize())
         dd.set_fused_exchange(True)
+    if kernel_variant == "persistent":
+        # the persistent whole-chunk variant (REMOTE_DMA only — realize()
+        # raises loudly otherwise): one radius*k exchange per k-step
+        # chunk, k = deep_halo (the radius the domain realized above)
+        dd.set_persistent_exchange(True)
     if wire_dtype:
         dd.set_wire_dtype(wire_dtype)
     if partition is not None:
@@ -202,11 +223,14 @@ def run(
             # the full default depth (no radius bound) and poison weak-scaling
             # columns against radius-capped N-chip runs (ADVICE r3)
             tk = deep_halo if deep_halo >= 2 else None
+            # the persistent chunk driver owns ALL call sizes (a 1-iter
+            # call is its depth-1 tail chunk); make_jacobi_step has no
+            # chunk schedule
             loops[k] = (
                 make_jacobi_loop(dd.halo_exchange, k, overlap=overlap,
                                  temporal_k=tk,
                                  multistep_rows=multistep_rows)
-                if k > 1
+                if k > 1 or kernel_variant == "persistent"
                 else make_jacobi_step(dd.halo_exchange, overlap=overlap)
             )
         return loops[k]
@@ -565,6 +589,16 @@ def main(argv: Optional[list] = None) -> int:
                         "starts boundary-first and interior compute hides "
                         "the wire (ops/fused_stencil.py; "
                         "fused.overlap_fraction in the metrics)")
+    p.add_argument("--kernel-variant", choices=["fused", "persistent"],
+                   default=None,
+                   help="REMOTE_DMA kernel variant (plan/ir.py vocabulary; "
+                        "an unknown value is rejected here, naming this "
+                        "set): 'fused' = the --fused overlap kernel; "
+                        "'persistent' = the whole-chunk temporal fusion "
+                        "(ops/persistent_stencil.py) — one radius*k "
+                        "exchange per k-step chunk with k = --deep-halo "
+                        "(>= 2 required), launch count O(chunks) not "
+                        "O(steps)")
     p.add_argument("--prefix", type=str, default="")
     p.add_argument("--cpu", type=int, default=0, help="force N virtual CPU devices")
     p.add_argument("--deep-halo", type=int, default=1,
@@ -582,6 +616,9 @@ def main(argv: Optional[list] = None) -> int:
     add_metrics_flags(p, dma=True)
     add_live_flags(p)
     args = p.parse_args(argv)
+    if args.fused and args.kernel_variant == "persistent":
+        p.error("--fused conflicts with --kernel-variant persistent "
+                "(mutually exclusive kernel variants)")
     try:
         canonicalize_live_config(args)
     except (OSError, ValueError) as e:
@@ -634,6 +671,7 @@ def main(argv: Optional[list] = None) -> int:
             inject=args.inject or None,
             wire_dtype=args.wire_dtype or None,
             fused=args.fused,
+            kernel_variant=args.kernel_variant,
             sentinel=sentinel,
             status=status,
             replan=args.replan,

@@ -257,29 +257,33 @@ def cmd_autotune(args) -> int:
     # it). Validated like --methods — a typo'd variant must fail here,
     # not land in the DB as a string no lowering recognizes.
     from ..plan.cost import DEFAULT_VARIANTS
-    from ..plan.ir import FUSED_VARIANT
+    from ..plan.ir import FUSED_VARIANT, PERSISTENT_VARIANT
 
     if args.variants:
         variants = []
         for t in (s.strip() for s in args.variants.split(",") if s.strip()):
             if t == "none":
                 variants.append(None)
-            elif t == FUSED_VARIANT:
-                variants.append(FUSED_VARIANT)
+            elif t in (FUSED_VARIANT, PERSISTENT_VARIANT):
+                variants.append(t)
             else:
                 raise SystemExit(
                     f"unknown kernel variant {t!r} (choose from "
-                    f"'{FUSED_VARIANT}', 'none')")
+                    f"'{FUSED_VARIANT}', '{PERSISTENT_VARIANT}', 'none')")
         variants = tuple(variants)
     else:
         variants = DEFAULT_VARIANTS
+    ks = tuple(int(t) for t in args.ks.split(",") if t.strip()) or (1,)
+    for k in ks:
+        if k < 1:
+            raise SystemExit(f"--ks depths must be >= 1, got {k}")
     res = autotune(
         Dim3(args.x, args.y, args.z), Radius.constant(args.radius),
         [args.dtype] * args.quantities,
         devices=jax.devices()[: args.ndev] if args.ndev else None,
         db_path=args.db or None, top_n=args.top_n,
         probe_iters=args.probe_iters, probe=not args.no_probe,
-        force=args.force, methods=methods, variants=variants,
+        force=args.force, methods=methods, ks=ks, variants=variants,
     )
     print(f"chosen: {res.choice.label()}")
     print(f"source: {res.source}  cache_hit: {res.cache_hit}  "
@@ -356,9 +360,16 @@ def main(argv: Optional[list] = None) -> int:
     sp.add_argument("--variants", default="",
                     help="comma list restricting the searched kernel "
                          "variants: 'fused' (the fused compute+exchange "
-                         "variant) and/or 'none' (the unvariant "
-                         "programs); default: the unvariant program + "
-                         "remote-dma's fused variant")
+                         "variant), 'persistent' (the whole-chunk "
+                         "mega-kernel; needs --ks depths >= 2) and/or "
+                         "'none' (the unvariant programs); default: the "
+                         "unvariant program + remote-dma's fused variant "
+                         "+ (when --ks reaches 2) its persistent "
+                         "variant")
+    sp.add_argument("--ks", default="1",
+                    help="comma list of temporal multistep depths to "
+                         "search (deep-halo k; e.g. '1,2,4' lets the "
+                         "persistent whole-chunk variant compete)")
     _add_config_flags(sp)
     from ._bench_common import add_metrics_flags
 

@@ -44,6 +44,22 @@ from .fd import field_data
 
 FIELDS = ("lnrho", "uux", "uuy", "uuz", "ax", "ay", "az", "entropy")
 
+# the fused-substep sliding-window vocabulary (ops/pallas_astaroth.py);
+# distinct from the exchange-plan kernel_variant ("fused"/"persistent")
+_VARIANTS = ("shift", "ring")
+
+
+def _check_variant(kernel_variant) -> None:
+    """Loud validation of the substep window variant at step-BUILD time,
+    env-var default included — off-TPU the Pallas kernel (which owns the
+    in-kernel check) never builds, and a typo'd STENCIL_ASTAROTH_VARIANT
+    must not silently run the default discipline."""
+    v = kernel_variant or os.environ.get("STENCIL_ASTAROTH_VARIANT")
+    if v is not None and v not in _VARIANTS:
+        raise ValueError(
+            f"unknown astaroth kernel variant {v!r} (--kernel-variant / "
+            f"STENCIL_ASTAROTH_VARIANT): valid values are {_VARIANTS}")
+
 # Williamson (1980) low-storage coefficients (reference: integration.cuh:19-21)
 RK3_ALPHA = (0.0, -5.0 / 9.0, -153.0 / 128.0)
 RK3_BETA = (1.0 / 3.0, 15.0 / 16.0, 8.0 / 15.0)
@@ -241,6 +257,7 @@ def make_astaroth_step(
     ``shift``) so the A/B runs without touching call sites."""
     spec = ex.spec
     r = spec.radius
+    _check_variant(kernel_variant)
     if min(r.y(-1), r.y(1), r.z(-1), r.z(1)) < 3:
         raise ValueError("astaroth needs face radius >= 3 (6th-order "
                          "stencils)")
@@ -515,6 +532,7 @@ def make_fused_astaroth_loop(
 
     spec = ex.spec
     r = spec.radius
+    _check_variant(kernel_variant)
     if ex.method != Method.REMOTE_DMA or not getattr(ex, "fused", False):
         raise ValueError(
             "make_fused_astaroth_loop needs HaloExchange(Method.REMOTE_DMA,"

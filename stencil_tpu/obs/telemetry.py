@@ -142,7 +142,8 @@ KNOWN_NAMES = frozenset(NAME_FIELDS) | frozenset({
     "dma.capture_error", "dma.skipped",
     "exchange.bytes_logical", "exchange.bytes_moved",
     "exchange.bytes_on_wire", "exchange.bytes_on_wire_per_quantity",
-    "exchange.gb_per_s", "exchange.iter", "exchange.permutes_per_quantity",
+    "exchange.gb_per_s", "exchange.iter", "exchange.launches_per_chunk",
+    "exchange.permutes_per_quantity",
     "exchange.trimean_s", "exchange.warmup",
     # interior-compute time over total fused-substep time: how much of
     # the wire the fused schedule actually hid (gauge, variant-tagged)
@@ -466,6 +467,21 @@ def record_exchange_truth(ex, state, itemsizes: Sequence[int],
     cp_count = census.get("collective-permute", (0, 0))[0]
     rec.gauge("exchange.permutes_per_quantity", cp_count / nq,
               phase="exchange", method=method, quantities=nq, **tags)
+    # launch-count census (ROADMAP #7): the step driver's measured host
+    # dispatches per chunk when a persistent/multistep loop ran
+    # (ops/jacobi sets last_launches_per_chunk), else the plan's static
+    # prediction — tagged so the auditor and the CI pin can tell a
+    # measurement from a model (utils/hlo_check.kernel_launch_census is
+    # the compiled-module side of the same evidence)
+    lpc = getattr(ex, "last_launches_per_chunk", 0)
+    src = "measured"
+    if not lpc:
+        plan = getattr(ex, "plan", None)
+        lpc = plan.launches_per_chunk() if plan is not None else 0
+        src = "modeled"
+    if lpc:
+        rec.gauge("exchange.launches_per_chunk", lpc, phase="exchange",
+                  method=method, source=src, **tags)
     rec.counter("exchange.bytes_logical", bytes=ex.bytes_logical(itemsizes),
                 phase="exchange", method=method, **tags)
     rec.counter("exchange.bytes_moved", bytes=ex.bytes_moved(itemsizes),

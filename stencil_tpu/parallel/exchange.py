@@ -140,7 +140,7 @@ class HaloExchange:
 
     def __init__(self, spec: GridSpec, mesh: Mesh, method: Method = Method.AXIS_COMPOSED,
                  batch_quantities: bool = True, wire_dtype=None,
-                 fused: bool = False):
+                 fused: bool = False, persistent: bool = False):
         md = mesh_dim(mesh)
         # oversubscription (reference: dd.set_gpus({0,0}), stencil.hpp:154,
         # test_exchange.cu:52): more partition blocks than devices — the
@@ -191,6 +191,38 @@ class HaloExchange:
                     f"{self.resident}); use plain REMOTE_DMA or "
                     "AXIS_COMPOSED for oversubscription"
                 )
+        # the persistent whole-chunk variant (ROADMAP #7): the EXCHANGE is
+        # the plain REMOTE_DMA slab transport at the deep radius*k the
+        # driver realized — what changes is the step structure (one
+        # exchange + ONE whole-chunk program per k-step chunk instead of
+        # per step; ops/persistent_stencil.py). The knob exists so the
+        # step compilers (ops/jacobi.py) dispatch the chunk loop and the
+        # plan carries the launches_per_chunk prediction.
+        self.persistent = bool(persistent)
+        if self.persistent:
+            if method != Method.REMOTE_DMA:
+                raise ValueError(
+                    "persistent=True is the REMOTE_DMA whole-chunk "
+                    f"kernel variant; got method {method}"
+                )
+            if self.fused:
+                raise ValueError(
+                    "fused and persistent are mutually exclusive kernel "
+                    "variants (the persistent chunk at k == 1 IS the "
+                    "fused substep)"
+                )
+            if self.resident != Dim3(1, 1, 1):
+                raise ValueError(
+                    "the persistent whole-chunk variant supports "
+                    "single-resident partitions only (got resident "
+                    f"{self.resident}); use plain REMOTE_DMA or "
+                    "AXIS_COMPOSED for oversubscription"
+                )
+        # launch census (satellite of ROADMAP #7): host-visible program
+        # dispatches of the last compiled step loop, per k-step chunk —
+        # set by the step compilers, audited against
+        # plan.launches_per_chunk (analysis/verify_plan.py)
+        self.last_launches_per_chunk: int = 0
         # bf16-on-the-wire halo compression: wire-crossing packed
         # carriers narrow to this dtype before the send and widen on
         # unpack (ops/halo_fill.wire_narrow_dtype owns the policy: only
@@ -225,6 +257,7 @@ class HaloExchange:
             self.spec, mesh_dim(self.mesh), self.method,
             batch_quantities=self.batch_quantities, resident=self.resident,
             wire_dtype=self.wire_dtype, fused=self.fused,
+            persistent=self.persistent,
         )
 
     # -- public API ----------------------------------------------------------

@@ -45,6 +45,7 @@ from .ir import (
     DIRECT26,
     FUSED_VARIANT,
     METHODS,
+    PERSISTENT_VARIANT,
     REMOTE_DMA,
     PlanChoice,
     PlanConfig,
@@ -94,6 +95,25 @@ DEFAULT_CALIBRATION: Dict[str, object] = {
     # measurement of the overlap exists yet; probe_remote_dma.py's fused
     # leg is the measurement that flips this to measured.
     "fused": {
+        "provenance": "modeled, pending item-1 TPU recalibration",
+    },
+    # The persistent whole-chunk mega-kernel (kernel_variant ==
+    # "persistent" on a REMOTE_DMA choice, multistep_k >= 2): one kernel
+    # launch executes the whole k-step chunk behind a single deep-halo
+    # (radius*k) exchange, so the chunk pays 2 program launches instead
+    # of the per-step lowering's 2k (plan/ir.ExchangePlan.
+    # launches_per_chunk — the same figure the launch census pins). The
+    # per-launch constants below price that saving: launch_overhead_s is
+    # the modeled TPU kernel-dispatch floor; cpu_dispatch_s is the
+    # host-orchestrated emulation's jit-call round-trip, priced honestly
+    # so persistent never wins a cpu ranking on a TPU-modeled constant.
+    # The redundant-compute side of the trade is the shared k>1
+    # shrinking-shell term below (cell_update_s). Provenance: MODELED,
+    # pending the item-1 TPU session — scripts/probe_persistent.py is the
+    # measurement that flips this to measured.
+    "persistent": {
+        "launch_overhead_s": 5.0e-6,
+        "cpu_dispatch_s": 2.0e-4,
         "provenance": "modeled, pending item-1 TPU recalibration",
     },
 }
@@ -290,10 +310,14 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
     config, else None. Mirrors realize()'s constraints exactly: the
     partition's block count must be a multiple of ndev (residents stacked
     by the same z-heavy factorization), and no block may be thinner than
-    the effective radius. The fused compute+exchange variant is a
-    REMOTE_DMA-only, single-resident lowering — any other combination is
-    infeasible here (the loud-infeasibility contract: realize() raises
-    the same constraints). A ``placement`` must be a permutation of the
+    the effective radius — for a multistep choice that radius is
+    ``radius * k``, so a deep-halo depth whose staging would exceed a
+    block's interior extent (a negative valid strip) is refused HERE,
+    before any kernel is planned. The fused compute+exchange variant is
+    a REMOTE_DMA-only, single-resident, k == 1 lowering; the persistent
+    whole-chunk variant is REMOTE_DMA-only, single-resident, k >= 2 —
+    any other combination is infeasible here (the loud-infeasibility
+    contract: realize() raises the same constraints). A ``placement`` must be a permutation of the
     config's ``ndev`` mesh positions (plan/ir.validate_placement — the
     same check realize() raises on)."""
     if validate_placement(choice.placement, config.ndev) is not None:
@@ -306,6 +330,15 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
             # ignores temporal_k (ops/jacobi._compile_jacobi_fused warns
             # and proceeds per-step) — scoring k>1 would amortize an
             # exchange the realized program pays every step
+            return None
+    if choice.kernel_variant == PERSISTENT_VARIANT:
+        if choice.method != REMOTE_DMA:
+            return None
+        if choice.multistep_k < 2:
+            # persistent IS communication-avoiding temporal fusion: the
+            # chunk depth is multistep_k, and at k == 1 the whole-chunk
+            # kernel degenerates to the fused per-step kernel — scoring
+            # it would duplicate that point under a second label
             return None
     dim = Dim3.of(choice.partition)
     g = Dim3.of(config.grid)
@@ -338,6 +371,9 @@ def feasible(config: PlanConfig, choice: PlanChoice) -> Optional[Tuple]:
                     dim.z // mesh_dim.z)
     if choice.kernel_variant == FUSED_VARIANT and resident != Dim3(1, 1, 1):
         return None  # the fused kernel is single-resident (build_plan raises)
+    if (choice.kernel_variant == PERSISTENT_VARIANT
+            and resident != Dim3(1, 1, 1)):
+        return None  # the persistent kernel is single-resident too
     return spec, mesh_dim, resident
 
 
@@ -374,9 +410,11 @@ def score(config: PlanConfig, choice: PlanChoice,
         return None
     spec, mesh_dim, resident = feas
     fused = choice.kernel_variant == FUSED_VARIANT
+    persistent = choice.kernel_variant == PERSISTENT_VARIANT
     plan = build_plan(spec, mesh_dim, choice.method,
                       batch_quantities=choice.batch_quantities,
-                      resident=resident, fused=fused)
+                      resident=resident, fused=fused,
+                      persistent=persistent)
     itemsizes = config.itemsizes()
     nq = config.num_quantities
     ngroups = config.dtype_group_count
@@ -393,6 +431,20 @@ def score(config: PlanConfig, choice: PlanChoice,
         base = placement_cost(w, link_costs)
         if base > 0:
             pratio = placement_cost(w, link_costs, choice.placement) / base
+    # REMOTE_DMA-family launch economics: the per-step lowering pays 2
+    # program launches per substep (exchange + sweep), the persistent
+    # whole-chunk kernel pays 2 per CHUNK — plan.launches_per_chunk(k)
+    # is that prediction (the launch census audits it), and the
+    # per-launch constant is platform-split like the per-copy one.
+    # The permute methods compile the chunk into one XLA program whose
+    # dispatch cost is already inside their measured permute constants,
+    # so no launch term applies there (launches_per_chunk == 1).
+    launch_s = 0.0
+    if choice.method == REMOTE_DMA:
+        ps = cal["persistent"]
+        per_launch = (ps["launch_overhead_s"] if config.platform == "tpu"
+                      else ps["cpu_dispatch_s"])
+        launch_s = plan.launches_per_chunk(choice.multistep_k) * per_launch
     if fused:
         # overlap-aware: the fused substep runs
         #   max(interior_compute, dma) + boundary_compute
@@ -427,6 +479,7 @@ def score(config: PlanConfig, choice: PlanChoice,
             dmas * per_dma
             + max(0.0, wire_s - interior_s)
             + local / cal["local_bytes_per_s"]
+            + launch_s
         )
     elif choice.method == REMOTE_DMA:
         # kernel-initiated copies: no ppermute dispatch at all; the
@@ -436,11 +489,17 @@ def score(config: PlanConfig, choice: PlanChoice,
         rd = cal["remote_dma"]
         per_dma = (rd["dma_overhead_s"] if config.platform == "tpu"
                    else rd["cpu_emulation_overhead_s"])
+        # the persistent whole-chunk variant shares this branch: its wire
+        # model IS the deep-halo composed slab program (same dmas, same
+        # bytes), and its whole advantage is the launch term — 2 per
+        # chunk instead of 2k — plus the /k exchange amortization below;
+        # its price is the shared k>1 redundant-compute term
         exchange_s = (
             dmas * per_dma
             + (wire / rd.get("wire_bytes_per_s", cal["wire_bytes_per_s"])
                * pratio)
             + local / cal["local_bytes_per_s"]
+            + launch_s
         )
     else:
         overhead = cal["permute_overhead_s"][choice.method]
@@ -492,9 +551,10 @@ def candidate_partitions(config: PlanConfig,
 
 
 # The default kernel-variant set, as an identity-comparable sentinel:
-# enumerate_candidates() grows it with REMOTE_DMA's fused variant, while
-# any EXPLICITLY passed variant list — (None,) included — is honored
-# verbatim (plan_tool --variants none tunes plain remote-dma only).
+# enumerate_candidates() grows it with REMOTE_DMA's fused and persistent
+# variants, while any EXPLICITLY passed variant list — (None,) included —
+# is honored verbatim (plan_tool --variants none tunes plain remote-dma
+# only).
 DEFAULT_VARIANTS: Tuple[Optional[str], ...] = (None,)
 
 
@@ -512,12 +572,15 @@ def enumerate_candidates(
     branches when the config has more than one quantity (at Q=1 the two
     programs are identical — PR 5's degeneration contract). With the
     DEFAULT variant set, REMOTE_DMA additionally branches on the fused
-    compute+exchange variant (kernel_variant == "fused") so the
-    autotuner searches the overlap lever out of the box; an EXPLICIT
+    compute+exchange variant (kernel_variant == "fused") and — whenever
+    ``ks`` reaches depth 2 — the persistent whole-chunk variant
+    (kernel_variant == "persistent") so the autotuner searches both the
+    overlap and the temporal-fusion levers out of the box; an EXPLICIT
     ``variants`` restriction — ``(None,)`` included — is honored
     verbatim (the sentinel comparison is by identity with
-    :data:`DEFAULT_VARIANTS`). Infeasible fused points (oversubscribed
-    partitions) fall out at score() like every other constraint.
+    :data:`DEFAULT_VARIANTS`). Infeasible variant points (oversubscribed
+    partitions, fused at k > 1, persistent at k < 2) fall out at score()
+    like every other constraint.
 
     With ``link_costs`` (non-uniform), every single-resident partition
     additionally branches on its QAP-solved placement
@@ -529,6 +592,7 @@ def enumerate_candidates(
     if config.num_quantities <= 1:
         batch_options = (True,)
     default_variants = variants is DEFAULT_VARIANTS
+    ks = tuple(ks)  # consumed once per method below, plus the k>=2 probe
     placements_by_part: Dict[Tuple[int, int, int],
                              Optional[Tuple[int, ...]]] = {}
 
@@ -558,9 +622,12 @@ def enumerate_candidates(
             placements = (None, placed)
         for method in methods:
             vlist = list(variants)
-            if (method == REMOTE_DMA and default_variants
-                    and FUSED_VARIANT not in vlist):
-                vlist.append(FUSED_VARIANT)
+            if method == REMOTE_DMA and default_variants:
+                if FUSED_VARIANT not in vlist:
+                    vlist.append(FUSED_VARIANT)
+                if (PERSISTENT_VARIANT not in vlist
+                        and any(k >= 2 for k in ks)):
+                    vlist.append(PERSISTENT_VARIANT)
             for batch in batch_options:
                 for k in ks:
                     for variant in vlist:

@@ -48,6 +48,18 @@ METHODS = (AXIS_COMPOSED, DIRECT26, AUTO_SPMD, REMOTE_DMA)
 # the plan DB persists it like any other point in the space.
 FUSED_VARIANT = "fused"
 
+# The persistent whole-chunk mega-kernel variant (ROADMAP #7): still
+# Method.REMOTE_DMA transport, but ONE kernel executes an entire k-step
+# chunk — deep-halo (radius*k) exteriors staged once per chunk, the
+# shrinking valid strip re-swept each substep with ring-indexed window
+# rotation, neighbor barrier semaphores between substeps — dropping the
+# launch count from O(steps) to O(chunks) at the price of redundant
+# boundary compute the cost model prices. A PlanChoice carries it as
+# ``kernel_variant == PERSISTENT_VARIANT`` (``multistep_k`` is the chunk
+# depth, so persistent requires k >= 2 — at k == 1 it IS the fused
+# kernel).
+PERSISTENT_VARIANT = "persistent"
+
 # Wire-compression itemsizes the IR can model without importing jax/numpy
 # (bfloat16 / float8_* are not numpy dtype names; everything else resolves
 # lazily). The fp8 tier (float8_e4m3fn) quarters fp32 on-wire bytes the
@@ -264,6 +276,11 @@ class ExchangePlan:
     # built when ``fused``; REMOTE_DMA-only — see FusedPhaseIR)
     fused_phases: Tuple[FusedPhaseIR, ...] = ()
     fused: bool = False
+    # the persistent whole-chunk variant (REMOTE_DMA only): the phase
+    # geometry stays the deep-halo composed slab program (remote_phases
+    # built against the radius*k spec); what changes is the launch
+    # economics — see :meth:`launches_per_chunk`.
+    persistent: bool = False
     synthesized: bool = False
     # bf16-on-the-wire halo compression: wire-crossing carriers narrow to
     # this dtype before the send and widen on unpack (None = native).
@@ -307,6 +324,35 @@ class ExchangePlan:
         phases = self.fused_phases if self.fused else self.remote_phases
         return sum(p.dmas() for p in phases) * carriers
 
+    def launches_per_chunk(self, k: int = 1) -> int:
+        """Predicted device-program launches one k-step chunk pays — the
+        figure ``exchange.launches_per_chunk`` gauges and verify_plan
+        audits against the runtime's dispatch counters, exactly like
+        collectives and DMA bytes.
+
+        The unit is host-visible program dispatches of the REMOTE_DMA
+        runtime (the kernel-per-dispatch regime the reference's §5.8
+        peer-access kernels live in; the CPU emulation counts the same
+        thing):
+
+        - ``persistent``: 2 per chunk, k-independent — ONE deep-halo
+          staging exchange + ONE whole-chunk program (on TPU the chunk
+          program is a single mega-kernel launch). O(chunks).
+        - plain / fused REMOTE_DMA: 2 per substep — an exchange program
+          and a sweep program each step. O(steps).
+        - permute methods and AUTO_SPMD: 1 — the chunk compiles into one
+          XLA program; its in-module kernel count (O(k), censused by
+          ``utils.hlo_check.kernel_launch_census``) is a different unit
+          and is not this prediction's subject.
+        """
+        if int(k) < 1:
+            raise ValueError(f"launches_per_chunk needs k >= 1, got {k}")
+        if self.method != REMOTE_DMA:
+            return 1
+        if self.persistent:
+            return 2
+        return 2 * int(k)
+
     def wire_bytes(self, itemsizes: Sequence[int],
                    floating: Optional[Sequence[bool]] = None) -> int:
         """Estimated bytes on the interconnect per exchange (all
@@ -345,6 +391,8 @@ class ExchangePlan:
             + (" (schedule synthesized by the SPMD partitioner)"
                if self.synthesized else "")
             + (" (fused compute+exchange kernel)" if self.fused else "")
+            + (" (persistent whole-chunk kernel)" if self.persistent
+               else "")
             + (f" wire_dtype={self.wire_dtype}" if self.wire_dtype else ""),
         ]
         for p in self.phases:
@@ -611,7 +659,8 @@ def _fused_phases(spec, mesh_dim: Dim3) -> Tuple[FusedPhaseIR, ...]:
 def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
                resident: Optional[Dim3] = None,
                wire_dtype: Optional[str] = None,
-               fused: bool = False) -> ExchangePlan:
+               fused: bool = False,
+               persistent: bool = False) -> ExchangePlan:
     """Build the ExchangePlan of one (GridSpec, mesh shape, method).
 
     Pure geometry — no jax, no devices. ``method`` may be the enum from
@@ -621,7 +670,10 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
     narrows wire-crossing carriers in the byte model (the bf16/fp8
     on-the-wire halo compression knob). ``fused`` builds the fused
     compute+exchange variant's per-direction message set (REMOTE_DMA
-    only, single-resident only — loud infeasibility otherwise).
+    only, single-resident only — loud infeasibility otherwise);
+    ``persistent`` marks the whole-chunk mega-kernel variant (same
+    constraints; the phase geometry stays the composed slab program
+    against the caller's deep-halo radius*k spec).
     """
     mval = getattr(method, "value", method)
     if mval not in METHODS:
@@ -630,6 +682,16 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
         raise ValueError(
             "the fused compute+exchange variant is a REMOTE_DMA lowering "
             f"(kernel-initiated copies); got method {mval!r}"
+        )
+    if persistent and mval != REMOTE_DMA:
+        raise ValueError(
+            "the persistent whole-chunk variant is a REMOTE_DMA lowering "
+            f"(kernel-initiated copies); got method {mval!r}"
+        )
+    if persistent and fused:
+        raise ValueError(
+            "fused and persistent are distinct kernel variants of one "
+            "plan — choose one (persistent at k == 1 IS the fused kernel)"
         )
     md = Dim3.of(mesh_dim)
     if spec.dim.x % md.x or spec.dim.y % md.y or spec.dim.z % md.z:
@@ -642,6 +704,12 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
     if fused and resident != Dim3(1, 1, 1):
         raise ValueError(
             "the fused compute+exchange kernel supports single-resident "
+            f"partitions only (got resident {resident}); use the plain "
+            "REMOTE_DMA carrier or AXIS_COMPOSED for oversubscription"
+        )
+    if persistent and resident != Dim3(1, 1, 1):
+        raise ValueError(
+            "the persistent whole-chunk kernel supports single-resident "
             f"partitions only (got resident {resident}); use the plain "
             "REMOTE_DMA carrier or AXIS_COMPOSED for oversubscription"
         )
@@ -663,6 +731,7 @@ def build_plan(spec, mesh_dim, method, batch_quantities: bool = True,
         remote_phases=remote_phases,
         fused_phases=fused_phases,
         fused=fused,
+        persistent=persistent,
         synthesized=synthesized,
         wire_dtype=wire_dtype,
     )
@@ -841,6 +910,12 @@ class PlanChoice:
     def is_fused(self) -> bool:
         """The fused compute+exchange mega-kernel variant of REMOTE_DMA."""
         return self.kernel_variant == FUSED_VARIANT
+
+    @property
+    def is_persistent(self) -> bool:
+        """The persistent whole-chunk mega-kernel variant of REMOTE_DMA
+        (deep-halo temporal fusion; ``multistep_k`` is the chunk depth)."""
+        return self.kernel_variant == PERSISTENT_VARIANT
 
     @property
     def is_placed(self) -> bool:

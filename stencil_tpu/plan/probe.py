@@ -16,7 +16,12 @@ share the exchange probe — the variant's compute delta rides the static
 model until app-level probes exist (ROADMAP #1's TPU ledger) — EXCEPT the
 fused compute+exchange variant, whose exchange program itself differs
 (concurrent per-direction kernel-initiated transport) and is probed as
-such via ``time_exchange(fused=True)``.
+such via ``time_exchange(fused=True)``. The persistent whole-chunk
+variant's EXCHANGE program is the deep-halo plain REMOTE_DMA slab
+program at radius*k — precisely what the scaled-radius probe above
+measures — so it shares that probe; its launch-count saving rides the
+static model's MODELED constants until scripts/probe_persistent.py runs
+on silicon (item 1).
 """
 
 from __future__ import annotations

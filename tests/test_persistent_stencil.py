@@ -1,0 +1,429 @@
+"""The persistent whole-chunk mega-kernel stack (ISSUE 16 / ROADMAP #7),
+pinned on the CPU emulation.
+
+The claims under test:
+
+- **plan IR**: ``persistent=True`` is REMOTE_DMA-only, single-resident-
+  only, k >= 2 only (loud everywhere: build_plan, HaloExchange, cost);
+  ``launches_per_chunk(k)`` predicts 2 per chunk for the persistent
+  lowering vs 2k for the per-step REMOTE_DMA lowerings and 1 for the
+  one-XLA-program methods.
+- **depth feasibility**: a chunk depth whose radius*k halo exceeds a
+  block interior is refused statically (plan/cost.feasible) AND at the
+  driver (check_chunk_depth) — never a silent wrong answer; the VMEM
+  staging planner (plan_multistep_staging) self-caps instead of
+  overflowing the budget.
+- **bit parity**: the host-orchestrated persistent chunk loop — ONE
+  deep (radius*k) exchange + ONE k-substep chunk program per chunk —
+  lands bit-identical to the composed per-step baseline across uniform
+  and UNEVEN partitions, k in {2, 4}, tail chunks included, with the
+  measured launch census pinned at 2 dispatches per chunk.
+- **interpret-mode kernel**: the single-device all-self-wrap mega-kernel
+  (in-kernel deep exchange + k plane-streamed substeps over a mod-3
+  plane ring) equals the XLA chunk body bit-for-bit, INCLUDING grown
+  z extents that wrap the ring mid-window (nz % 3 != 0).
+- **guarded loop**: the persistent step drives fault/recover.run_guarded
+  end-to-end — rollback recomputation is bit-identical to a clean run.
+- **plan plumbing**: the autotuner searches the persistent variant at
+  k >= 2, persists it, replays it probe-free; verify_plan audits the
+  persistent lowering's census/DMA/launch predictions.
+
+Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.ops.jacobi import INIT_TEMP, make_jacobi_loop, sphere_sel
+from stencil_tpu.ops.persistent_stencil import (
+    check_chunk_depth,
+    chunk_schedule,
+    make_persistent_chunk_body,
+    make_persistent_jacobi_kernel,
+    persistent_kernel_supported,
+    _deep_dir_phases,
+)
+from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
+from stencil_tpu.parallel.exchange import shard_blocks, unshard_blocks
+from stencil_tpu.plan.ir import (PERSISTENT_VARIANT, REMOTE_DMA, PlanChoice,
+                                 PlanConfig, build_plan)
+
+
+# -- plan IR -------------------------------------------------------------------
+
+
+def test_persistent_plan_launch_prediction():
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    pers = build_plan(spec, Dim3(2, 2, 2), REMOTE_DMA, persistent=True)
+    plain = build_plan(spec, Dim3(2, 2, 2), REMOTE_DMA)
+    composed = build_plan(spec, Dim3(2, 2, 2), "axis-composed")
+    # 2 dispatches per CHUNK (deep exchange + chunk program) vs 2 per
+    # STEP for the per-step remote-dma lowerings; the ppermute methods
+    # compile the whole chunk into one XLA program
+    assert pers.launches_per_chunk(4) == 2
+    assert pers.launches_per_chunk(1) == 2
+    assert plain.launches_per_chunk(4) == 8
+    assert composed.launches_per_chunk(4) == 1
+    # the deep exchange itself is the plain remote-dma slab schedule:
+    # same per-exchange DMA and collective counts
+    assert pers.collectives_per_exchange(2, 1) == 0
+    assert pers.dmas_per_exchange(1, 1) == plain.dmas_per_exchange(1, 1)
+    assert "persistent" in pers.describe()
+
+
+def test_persistent_plan_validation_is_loud():
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    with pytest.raises(ValueError, match="REMOTE_DMA"):
+        build_plan(spec, Dim3(2, 2, 2), "axis-composed", persistent=True)
+    with pytest.raises(ValueError, match="single-resident"):
+        build_plan(spec, Dim3(2, 2, 1), REMOTE_DMA, persistent=True)
+    with pytest.raises(ValueError, match="distinct kernel variants"):
+        build_plan(spec, Dim3(2, 2, 2), REMOTE_DMA, fused=True,
+                   persistent=True)
+
+
+def test_persistent_ctor_validation_is_loud():
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    with pytest.raises(ValueError, match="REMOTE_DMA"):
+        HaloExchange(spec, mesh, Method.AXIS_COMPOSED, persistent=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        HaloExchange(spec, mesh, Method.REMOTE_DMA, fused=True,
+                     persistent=True)
+
+
+def test_persistent_choice_searched_and_gated():
+    from stencil_tpu.plan.cost import enumerate_candidates, score
+
+    cfg = PlanConfig.make(Dim3(24, 24, 24), Radius.constant(1),
+                          ["float32"], 8, "cpu")
+    # the default variant set grows persistent once ks reaches depth 2
+    cands = enumerate_candidates(cfg, ks=(1, 2))
+    pers = [c for c in cands if c.is_persistent]
+    assert pers and all(c.method == REMOTE_DMA for c in pers)
+    # k = 1 points are emitted but fall out at score() (below); the
+    # searchable ones carry the real chunk depth
+    assert any(c.multistep_k >= 2 for c in pers)
+    assert not any(c.is_persistent for c in enumerate_candidates(cfg))
+    # k < 2 degenerates to the fused point: infeasible under this label
+    assert score(cfg, PlanChoice(partition=(2, 2, 2), method=REMOTE_DMA,
+                                 kernel_variant=PERSISTENT_VARIANT)) is None
+    # non-REMOTE_DMA and oversubscribed partitions are infeasible
+    assert score(cfg, PlanChoice(partition=(2, 2, 2), method="axis-composed",
+                                 kernel_variant=PERSISTENT_VARIANT,
+                                 multistep_k=2)) is None
+    assert score(cfg, PlanChoice(partition=(2, 2, 4), method=REMOTE_DMA,
+                                 kernel_variant=PERSISTENT_VARIANT,
+                                 multistep_k=2)) is None
+
+
+def test_persistent_fused_are_mutually_exclusive_choices():
+    c = PlanChoice(partition=(2, 2, 2), method=REMOTE_DMA,
+                   kernel_variant=PERSISTENT_VARIANT, multistep_k=2)
+    assert c.is_persistent and not c.is_fused
+    assert PlanChoice.from_json(c.to_json()).is_persistent
+
+
+# -- depth feasibility: radius*k vs block interior -----------------------------
+
+
+def test_deep_halo_exceeding_interior_is_refused_statically():
+    from stencil_tpu.plan.cost import feasible
+
+    # 16^3 / (1, 2, 4): z blocks are 4 cells; radius 2 at k = 2 realizes
+    # a 4-cell halo — exactly feasible; k = 3 (6 cells) is not
+    cfg = PlanConfig.make(Dim3(16, 16, 16), Radius.constant(2),
+                          ["float32"], 8, "cpu")
+    ok = PlanChoice(partition=(1, 2, 4), method=REMOTE_DMA,
+                    kernel_variant=PERSISTENT_VARIANT, multistep_k=2)
+    bad = PlanChoice(partition=(1, 2, 4), method=REMOTE_DMA,
+                     kernel_variant=PERSISTENT_VARIANT, multistep_k=3)
+    assert feasible(cfg, ok) is not None
+    assert feasible(cfg, bad) is None
+
+
+def test_check_chunk_depth_refuses_loudly():
+    # radius shallower than the chunk depth: substep 0 would read past
+    # the staged halo
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    with pytest.raises(ValueError, match="radius >= 3"):
+        check_chunk_depth(spec, 3)
+    check_chunk_depth(spec, 2)  # feasible: no raise
+    # depth deeper than the block interior: the shrinking valid strip
+    # would go negative even with the halo staged
+    deep = GridSpec(Dim3(16, 16, 16), Dim3(1, 1, 4), Radius.constant(8))
+    with pytest.raises(ValueError, match="interior"):
+        check_chunk_depth(deep, 8)
+
+
+def test_multistep_staging_planner_self_caps_never_overflows():
+    from stencil_tpu.ops.pallas_stencil import plan_multistep_staging
+
+    spec = GridSpec(Dim3(128, 128, 128), Dim3(1, 1, 8), Radius.constant(1))
+    # a generous budget reaches the requested depth with full planes
+    k, rows = plan_multistep_staging(spec, 4, budget=64 << 20)
+    assert k == 4 and rows is None
+    # a starved budget CAPS the depth rather than planning an overflow
+    k_small, _rows = plan_multistep_staging(spec, 4, budget=1 << 18)
+    assert k_small < 4
+
+
+def test_chunk_schedule_and_launch_arithmetic():
+    assert chunk_schedule(8, 2) == [2, 2, 2, 2]
+    assert chunk_schedule(10, 4) == [4, 4, 2]
+    assert chunk_schedule(0, 4) == []
+    with pytest.raises(ValueError, match=">= 1"):
+        chunk_schedule(8, 0)
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    assert persistent_kernel_supported(spec, Dim3(1, 1, 1))
+    uneven = GridSpec(Dim3(17, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    assert not persistent_kernel_supported(uneven, Dim3(1, 1, 1))
+
+
+# -- bit parity vs the composed baseline ---------------------------------------
+
+
+def _run_jacobi(size, dim, k, iters, persistent):
+    spec = GridSpec(Dim3(*size), Dim3(*dim), Radius.constant(k))
+    mesh = grid_mesh(spec.dim, jax.devices()[: spec.dim.flatten()])
+    if persistent:
+        ex = HaloExchange(spec, mesh, Method.REMOTE_DMA, persistent=True)
+        loop = make_jacobi_loop(ex, iters, temporal_k=k)
+    else:
+        ex = HaloExchange(spec, mesh, Method.AXIS_COMPOSED)
+        loop = make_jacobi_loop(ex, iters)
+    g = spec.global_size
+    c = shard_blocks(np.full((g.z, g.y, g.x), INIT_TEMP, np.float32),
+                     spec, mesh)
+    n = jax.device_put(jnp.zeros_like(c), ex.sharding())
+    sel = shard_blocks(sphere_sel((g.x, g.y, g.z)), spec, mesh)
+    c, _ = loop(c, n, sel)
+    return unshard_blocks(c, spec), getattr(ex, "last_launches_per_chunk", 0)
+
+
+@pytest.mark.parametrize("name,size,dim,k,iters", [
+    ("uniform-k2", (24, 24, 24), (2, 2, 2), 2, 8),
+    ("uniform-k4-tail2", (24, 24, 24), (2, 2, 2), 4, 10),
+    ("uneven-k2", (18, 20, 22), (1, 2, 4), 2, 6),
+    ("uneven-k3-tail1", (18, 20, 22), (1, 2, 4), 3, 7),
+])
+def test_persistent_bit_parity_vs_composed(name, size, dim, k, iters):
+    base, _ = _run_jacobi(size, dim, k, iters, persistent=False)
+    pers, lpc = _run_jacobi(size, dim, k, iters, persistent=True)
+    np.testing.assert_array_equal(base, pers, err_msg=name)
+    # the measured launch census: 2 host dispatches per chunk (deep
+    # exchange + chunk program), tail chunks included
+    assert lpc == 2, name
+
+
+# -- the interpret-mode mega-kernel --------------------------------------------
+
+
+def _self_wrap(spec, arr):
+    """The host-side replica of the kernel's deep exchange geometry on a
+    single all-self-wrap block (same ``_deep_dir_phases`` records)."""
+    out = arr.copy()
+    for _d, src, dst, shape, _c in _deep_dir_phases(spec, Dim3(1, 1, 1)):
+        s = tuple(slice(a, a + w) for a, w in zip(src, shape))
+        d = tuple(slice(a, a + w) for a, w in zip(dst, shape))
+        out[d] = arr[s]
+    return out
+
+
+@pytest.mark.parametrize("size,k", [
+    ((16, 16, 14), 2),   # grown z extent % 3 != 0: ring wraps mid-window
+    ((16, 16, 16), 3),
+    ((16, 16, 13), 4),
+])
+def test_persistent_kernel_interpret_parity_vs_xla_chunk(size, k):
+    import types
+
+    gx, gy, gz = size
+    spec = GridSpec(Dim3(gx, gy, gz), Dim3(1, 1, 1), Radius.constant(k))
+    pz, py, px = spec.block_shape_zyx()
+    rng = np.random.default_rng(0)
+    curr = rng.standard_normal((pz, py, px)).astype(np.float32)
+    sel = _self_wrap(spec, rng.integers(0, 3, size=(pz, py, px))
+                     .astype(np.int32))
+    nxt = np.zeros_like(curr)
+
+    # baseline: host-exchanged halos + the XLA chunk body
+    chunk = jax.jit(make_persistent_chunk_body(spec, k))
+    fin, _ = chunk(jnp.asarray(_self_wrap(spec, curr)), jnp.asarray(nxt),
+                   jnp.asarray(sel))
+
+    plan = types.SimpleNamespace(mesh_dim=(1, 1, 1))
+    kern = make_persistent_jacobi_kernel(spec, plan, k, interpret=True)
+    c2, o2, _ = kern(jnp.asarray(curr), jnp.asarray(nxt), jnp.asarray(sel))
+    got = np.asarray(o2 if k % 2 else c2)
+
+    off, b = spec.compute_offset(), spec.base
+    sl = (slice(off.z, off.z + b.z), slice(off.y, off.y + b.y),
+          slice(off.x, off.x + b.x))
+    np.testing.assert_array_equal(np.asarray(fin)[sl], got[sl])
+
+
+def test_persistent_kernel_interpret_rejects_multi_device_form():
+    import types
+
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    plan = types.SimpleNamespace(mesh_dim=(2, 2, 2))
+    with pytest.raises(ValueError, match="interpret"):
+        make_persistent_jacobi_kernel(spec, plan, 2, interpret=True)
+
+
+# -- the guarded loop (fault/recover) ------------------------------------------
+
+
+def test_persistent_loop_through_guarded_rollback():
+    """run_guarded drives the persistent chunk loop: a NaN injection
+    rolls back to the newest clean snapshot and the recomputation lands
+    bit-identical to a clean guarded run AND to the composed baseline."""
+    from stencil_tpu.fault import (FaultPlan, HealthGuard, RecoveryPolicy,
+                                   chunk_plan, parse_spec, run_guarded)
+
+    size, dim, k, iters = (24, 24, 24), (2, 2, 2), 2, 8
+    spec = GridSpec(Dim3(*size), Dim3(*dim), Radius.constant(k))
+    mesh = grid_mesh(spec.dim, jax.devices()[:8])
+    ex = HaloExchange(spec, mesh, Method.REMOTE_DMA, persistent=True)
+    g = spec.global_size
+    sel = shard_blocks(sphere_sel(size), spec, mesh)
+    loops = {}
+
+    def step_fn(st, n):
+        loop = loops.get(n)
+        if loop is None:
+            loop = loops[n] = make_jacobi_loop(ex, n, temporal_k=k)
+        nxt = jax.device_put(jnp.zeros_like(st["t"]), ex.sharding())
+        c, _n = loop(st["t"], nxt, sel)
+        return {"t": c}
+
+    def start_state():
+        return {"t": shard_blocks(
+            np.full((g.z, g.y, g.x), INIT_TEMP, np.float32), spec, mesh)}
+
+    snaps = {}
+
+    def save(step, st):
+        snaps[step] = np.asarray(st["t"]).copy()
+
+    def restore():
+        if not snaps:
+            return None
+        s = max(snaps)
+        return s, {"t": jax.device_put(jnp.asarray(snaps[s]),
+                                       ex.sharding())}
+
+    clean, done = run_guarded(
+        start_state(), start=0, iters=iters,
+        plan_fn=lambda s: chunk_plan(s, iters, k, every=(k,)),
+        step_fn=step_fn)
+    assert done == iters
+
+    plan = FaultPlan(parse_spec("nan@5"))
+    state, done = run_guarded(
+        start_state(), start=0, iters=iters,
+        plan_fn=lambda s: chunk_plan(s, iters, k, every=(k, k),
+                                     at=plan.steps()),
+        step_fn=step_fn, guard=HealthGuard(every=k), injector=plan,
+        policy=RecoveryPolicy(backoff_s=0.001),
+        save_fn=save, ckpt_every=k, restore_fn=restore)
+    assert done == iters
+    np.testing.assert_array_equal(np.asarray(state["t"]),
+                                  np.asarray(clean["t"]))
+    for step, snap in snaps.items():
+        assert np.isfinite(snap).all(), f"poisoned snapshot at {step}"
+
+    base, _ = _run_jacobi(size, dim, k, iters, persistent=False)
+    np.testing.assert_array_equal(
+        base, unshard_blocks(jnp.asarray(clean["t"]), spec))
+
+
+# -- conformance auditor + autotune round-trip ---------------------------------
+
+
+def test_verify_plan_audits_persistent_lowering():
+    from stencil_tpu.analysis import verify_plan as vp
+
+    configs = vp.sweep_configs(size=16, radius=2, partitions=[(2, 2, 2)],
+                               methods=[vp.PERSISTENT_METHOD_LABEL],
+                               qsets=[("float32",)])
+    res = vp.run_sweep(configs)
+    assert res["checked"] == 1 and res["failed"] == 0
+    checks = {c["name"]: c for c in res["verdicts"][0].checks}
+    assert checks["census_bytes"]["actual"] == 0
+    assert checks["dma_transfers"]["ok"]
+    # the launch census is a conformance-audited PREDICTION: measured
+    # dispatches per chunk == plan.launches_per_chunk(k) == 2
+    assert checks["launches_per_chunk"]["predicted"] == 2
+    assert checks["launches_per_chunk"]["ok"]
+    res = vp.run_sweep(configs, perturb_dmas=1)
+    assert res["failed"] == 1
+
+
+def test_verify_plan_default_sweep_includes_persistent():
+    from stencil_tpu.analysis import verify_plan as vp
+
+    assert vp.PERSISTENT_METHOD_LABEL in {
+        c["method"] for c in vp.sweep_configs()}
+
+
+def test_autotune_persists_persistent_variant_entry(tmp_path):
+    from stencil_tpu.plan import db as plandb
+    from stencil_tpu.plan.autotune import autotune
+
+    db_path = str(tmp_path / "plans.json")
+    kwargs = dict(ndev=8, platform="cpu", db_path=db_path, probe=False,
+                  methods=("remote-dma",), ks=(2,),
+                  variants=(PERSISTENT_VARIANT,))
+    res = autotune(Dim3(16, 16, 16), Radius.constant(1), ["float32"],
+                   **kwargs)
+    assert res.choice.is_persistent and res.choice.method == "remote-dma"
+    assert res.choice.multistep_k == 2
+    db = plandb.load_db(db_path)
+    entry = plandb.lookup(db, res.config)
+    assert PlanChoice.from_json(entry["choice"]).is_persistent
+    res2 = autotune(Dim3(16, 16, 16), Radius.constant(1), ["float32"],
+                    **kwargs)
+    assert res2.cache_hit and res2.choice.is_persistent
+
+
+def test_domain_realizes_tuned_persistent_plan():
+    from stencil_tpu.api import DistributedDomain
+
+    dd = DistributedDomain(16, 16, 16, plan={
+        "partition": [2, 2, 2], "method": "remote-dma",
+        "batch_quantities": True, "multistep_k": 2,
+        "kernel_variant": "persistent",
+    })
+    dd.set_radius(2)  # radius * k as the tuned plan realizes it
+    dd.set_devices(jax.devices()[:8])
+    dd.add_data("t", "float32")
+    dd.realize()
+    assert dd.halo_exchange.persistent
+    assert dd.plan_meta()["choice"]["kernel_variant"] == "persistent"
+
+
+def test_jacobi3d_app_rejects_unknown_variant():
+    from stencil_tpu.apps.jacobi3d import run
+
+    with pytest.raises(ValueError, match="valid values"):
+        run(8, 8, 8, iters=1, kernel_variant="bogus")
+    with pytest.raises(ValueError, match="deep-halo"):
+        run(8, 8, 8, iters=1, kernel_variant="persistent")
+
+
+def test_astaroth_variant_checked_at_build_time(monkeypatch):
+    from stencil_tpu.astaroth.integrate import _check_variant
+
+    _check_variant(None)
+    _check_variant("ring")
+    with pytest.raises(ValueError, match="valid values"):
+        _check_variant("bogus")
+    monkeypatch.setenv("STENCIL_ASTAROTH_VARIANT", "rnig")
+    with pytest.raises(ValueError, match="STENCIL_ASTAROTH_VARIANT"):
+        _check_variant(None)
