@@ -177,7 +177,7 @@ def _child_main(mode: str, resume: bool = False) -> int:
 
     def _exchange_leg(method, nq: int = 4, ndev: int = 1, nb: int = None,
                       batched: bool = True, dim: Dim3 = None,
-                      placement=None) -> float:
+                      placement=None, hierarchy=None) -> float:
         nb = nb if nb is not None else n
         if dim is None:
             dim = Dim3(2, 2, 2) if ndev == 8 else Dim3(1, 1, 1)
@@ -188,7 +188,8 @@ def _child_main(mode: str, resume: bool = False) -> int:
             # devs[placement[i]] (the PlanChoice.placement convention)
             devs = [devs[placement[i]] for i in range(len(devs))]
         mesh = grid_mesh(spec.dim, devs, ordered=placement is not None)
-        ex = HaloExchange(spec, mesh, method, batch_quantities=batched)
+        ex = HaloExchange(spec, mesh, method, batch_quantities=batched,
+                          hierarchy=hierarchy)
         loop = ex.make_loop(chunk)
         state = {
             i: shard_blocks(np.zeros((nb, nb, nb), np.float32), spec, mesh)
@@ -383,6 +384,37 @@ def _child_main(mode: str, resume: bool = False) -> int:
         except Exception as e:
             errors["exchange_placed"] = f"{type(e).__name__}: {e}"[:400]
 
+    # hierarchical ICI+DCN leg (ISSUE 17 / ROADMAP #3): the composed
+    # exchange at 128^3 on the 8-dev mesh split into 2 virtual hosts x 4
+    # devices (STENCIL_VIRTUAL_HOSTS emulation), z-outer hierarchy vs
+    # the flat single-level plan on the same 1x2x4 partition. Results
+    # are bit-identical by construction; on the CPU child the "DCN"
+    # copies are host-orchestrated device_puts between in-process
+    # devices, so the tracked ratio prices that orchestration overhead
+    # (expected <= 1), not a real two-tier fabric — only a multi-host
+    # TPU run (scripts/probe_dcn.py seeds its calibration) carries the
+    # cross-host overlap claim.
+    ex_hier_gb_s = 0.0
+    ex_hier_flat_gb_s = 0.0
+    if leg("halo exchange (hierarchical vs flat, 2 virtual hosts)"):
+        vh_prev = os.environ.get("STENCIL_VIRTUAL_HOSTS")
+        try:
+            ndevh = 8 if len(jax.devices()) >= 8 else 1
+            hx = dict(nq=4, ndev=ndevh, nb=min(n, 128),
+                      dim=Dim3(1, 2, 4) if ndevh == 8 else Dim3(1, 1, 1))
+            if ndevh == 8:
+                os.environ["STENCIL_VIRTUAL_HOSTS"] = "2"
+                ex_hier_gb_s = _exchange_leg(
+                    Method.AXIS_COMPOSED, hierarchy=("z", 2), **hx)
+            ex_hier_flat_gb_s = _exchange_leg(Method.AXIS_COMPOSED, **hx)
+        except Exception as e:
+            errors["exchange_hierarchical"] = f"{type(e).__name__}: {e}"[:400]
+        finally:
+            if vh_prev is None:
+                os.environ.pop("STENCIL_VIRTUAL_HOSTS", None)
+            else:
+                os.environ["STENCIL_VIRTUAL_HOSTS"] = vh_prev
+
     # exchange-plan autotuner leg (ROADMAP #3): tune (partition x method x
     # batching) for a radius-3 4-quantity config, then time the tuned plan
     # against the plan-less default (NodePartition + AXIS_COMPOSED +
@@ -575,6 +607,17 @@ def _child_main(mode: str, resume: bool = False) -> int:
         "exchange_placed_over_identity": (
             round(ex_placed_gb_s / ex_ident_gb_s, 3)
             if ex_ident_gb_s else 0.0
+        ),
+        # hierarchical ICI+DCN leg: two-level (2 virtual hosts x 4 dev)
+        # exchange over the flat plan at the same 1x2x4 config — a
+        # parity/no-regression pin on CPU (the emulated DCN copies are
+        # in-process device_puts, so <= 1 is the honest expectation);
+        # the cross-host overlap claim needs a real multi-host fabric
+        "exchange_hierarchical_gb_per_s": round(ex_hier_gb_s, 2),
+        "exchange_hier_flat_gb_per_s": round(ex_hier_flat_gb_s, 2),
+        "exchange_hierarchical_over_flat": (
+            round(ex_hier_gb_s / ex_hier_flat_gb_s, 3)
+            if ex_hier_flat_gb_s else 0.0
         ),
         # exchange-plan autotuner leg: tuned plan's bandwidth over the
         # plan-less default at the same config (> 1: the tuner won)

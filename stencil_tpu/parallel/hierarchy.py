@@ -1,0 +1,448 @@
+"""Hierarchical (ICI + DCN) halo exchange — the two-level transport.
+
+The reference is explicitly multi-node: its L2/L3 layers discover the
+MPI/node topology and place blocks node-aware before any GPU-level
+transport runs (reference: include/stencil/topology.hpp, NodeAware
+placement via qap::solve). This module is that outer level for the TPU
+port: an :class:`HierarchicalExchange` wraps a flat
+:class:`~.exchange.HaloExchange` whose plan carries a ``hierarchy``
+(axis, hosts) split, and moves the host-boundary slabs across the DCN
+while the inner per-host program stays on the ICI.
+
+Two schedules, chosen by the inner method:
+
+- **overlapped** (AXIS_COMPOSED inner — the perf claim): extract the
+  cross-host boundary slabs from the PRE-exchange state and START the
+  DCN copies, run the compiled DCN-axis phase (host-local wrap pairs)
+  while they fly — intra-host wire time hides the DCN latency, the same
+  overlap shape the fused kernel uses for ICI DMAs — then apply the
+  arrived slabs and run the remaining two axis phases, whose
+  full-padded-extent slabs overwrite every stale strip. Because each
+  phase's slabs span the full padded extents of the other axes, the
+  composed exchange is order-insensitive, so running the DCN axis first
+  is bit-identical to the flat x->y->z program.
+- **sequential** (REMOTE_DMA family inner, fused/persistent variants
+  included): run the FULL inner exchange first (its DCN-axis neighbor
+  arithmetic wraps within each host segment — remote_emu._seg_wrap),
+  then extract the sender boundary slabs POST-inner, when their
+  orthogonal halos are already valid, and apply each to the receiver's
+  whole DCN-axis halo side: one full-extent slab fixes face, edges and
+  corners at once, overwriting every wrap-garbage cell (all of which
+  are confined to that side by construction).
+
+The DCN transport itself is the PR-10 host-orchestrated machinery
+(parallel/remote_emu.py's take -> device_put -> update split): compiled
+per-device take/update programs with ZERO collectives, carriers narrowed
+to ``wire_dtype`` on extraction and widened on apply (one rounding —
+exactly what the flat ppermute pays), and an executed-copy counter
+(:attr:`last_transfer_count`) that analysis/verify_plan audits against
+``plan.dcn_transfers_per_exchange``. In-process the "hosts" are the
+``STENCIL_VIRTUAL_HOSTS`` fabric (parallel/device_topo.py); real
+multi-process DCN is staged for the hardware session (ROADMAP #1).
+"""
+
+from __future__ import annotations
+
+from functools import cached_property
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.halo_fill import pack_slabs, unpack_slabs, wire_narrow_dtype
+from ..utils import timer
+from .device_topo import host_assignment, virtual_hosts
+from .mesh import BLOCK_PSPEC
+
+
+class HierarchicalExchange:
+    """Two-level lowering of a hierarchical ExchangePlan.
+
+    Built by :attr:`HaloExchange._compiled` when the plan's
+    ``hierarchy`` names more than one host; callers use it exactly like
+    the flat compiled exchange (``__call__``/``make_loop``/
+    ``collective_census``)."""
+
+    def __init__(self, ex):
+        from .exchange import Method  # late: exchange.py builds us
+
+        self.ex = ex
+        self.mesh = ex.mesh
+        self.plan = ex.plan
+        if self.plan.hierarchy is None:
+            raise ValueError("HierarchicalExchange needs a plan with a "
+                             "hierarchy (got a flat plan)")
+        self.axis, self.hosts = self.plan.hierarchy
+        if self.hosts < 2:
+            raise ValueError(
+                f"hierarchy names {self.hosts} host(s) — the two-level "
+                "transport needs >= 2 (a 1-host split is the flat plan)")
+        self._composed = ex.method == Method.AXIS_COMPOSED
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "the hierarchical DCN transport is host-orchestrated "
+                "in-process today (device_put between emulated hosts); "
+                "real multi-process DCN rides the hardware session "
+                "(ROADMAP #1)"
+            )
+        if not self._composed and ex._on_tpu():
+            raise NotImplementedError(
+                "hierarchical REMOTE_DMA on a TPU mesh is staged for the "
+                "hardware session: the carrier kernels "
+                "(ops/remote_dma.py, ops/fused_stencil.py) address the "
+                "full ring, not host segments — use the AXIS_COMPOSED "
+                "inner method or the CPU-emulation fabric "
+                "(STENCIL_VIRTUAL_HOSTS)"
+            )
+        self._axis_of = {"z": 0, "y": 1, "x": 2}[self.axis]
+        self._coords: Dict[int, Tuple[int, int, int]] = {}
+        md = self.mesh.devices
+        for iz in range(md.shape[0]):
+            for iy in range(md.shape[1]):
+                for ix in range(md.shape[2]):
+                    self._coords[md[iz, iy, ix].id] = (iz, iy, ix)
+        self.m = md.shape[self._axis_of]
+        self.seg = self.m // self.hosts
+        # the DCN axis geometry is the composed axis phase's — one
+        # authority for offsets/sizes/radii (plan/ir.spec_axis)
+        self._phase = next(
+            p for p in self.plan.axis_phases if p.axis == self.axis
+        )
+        self._validate_alignment()
+        self._jits: Dict[tuple, object] = {}
+        self._avals: Dict[tuple, tuple] = {}
+        self.last_transfer_count = 0  # executed DCN copies, last exchange
+        self.last_transfer_bytes = 0  # executed DCN bytes, last exchange
+
+    def _validate_alignment(self) -> None:
+        """Every axis segment must live on exactly one distinct host:
+        the outer split claims its boundary slabs cross the DCN and
+        nothing else does, which is only true when the realized mesh
+        groups each segment onto one host (the two-level placement
+        composes device order to guarantee this; identity order aligns
+        for a z split over contiguous hosts)."""
+        devs = list(self.mesh.devices.flat)
+        assign = host_assignment(devs)
+        seg_host: Dict[int, int] = {}
+        ok = True
+        for d, h in zip(devs, assign):
+            s = self._coords[d.id][self._axis_of] // self.seg
+            if seg_host.setdefault(s, h) != h:
+                ok = False
+        if ok and len(set(seg_host.values())) != self.hosts:
+            ok = False
+        if not ok:
+            hint = (
+                f"set STENCIL_VIRTUAL_HOSTS={self.hosts} and realize "
+                "with the two-level placement (plan/cost."
+                "solve_two_level_placement) so device order groups each "
+                "segment onto one host"
+                if virtual_hosts() == 0
+                else "realize with the two-level placement (plan/cost."
+                "solve_two_level_placement) so device order groups each "
+                "segment onto one host"
+            )
+            raise ValueError(
+                f"hierarchical exchange: the {self.hosts} segments of "
+                f"the {self.axis} axis do not align with the host "
+                f"fabric (mesh-order host assignment {assign}); {hint}"
+            )
+
+    # -- compiled pieces ------------------------------------------------------
+    def _jit(self, key, build):
+        if key not in self._jits:
+            self._jits[key] = jax.jit(build())
+        return self._jits[key]
+
+    def _remember(self, key, args) -> None:
+        if key not in self._avals:
+            self._avals[key] = tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args
+            )
+
+    def _device_sizes(self, i: int) -> Tuple[int, ...]:
+        c = self._phase.resident
+        return tuple(int(self._phase.sizes[i * c + j]) for j in range(c))
+
+    def _take_fn(self, sizes, shard_shape, nq, wire, send_hi, send_lo):
+        """take(*shards) -> the boundary carriers this segment-edge
+        device sends across the DCN: +axis (``send_hi``) is its LAST
+        resident's top ``rm`` rows, -axis (``send_lo``) its FIRST
+        resident's bottom ``rp`` rows — full padded orthogonal extents
+        (stale strips included; later/earlier inner phases overwrite
+        them), packed per dtype group and narrowed to the wire dtype
+        when compression is on (every DCN carrier crosses a wire)."""
+        ph = self._phase
+        rm, rp, off, adim, bdim, c = (ph.rm, ph.rp, ph.offset, ph.adim,
+                                      ph.bdim, ph.resident)
+        sz_last = sizes[c - 1]
+
+        def slab(s, j, start, width):
+            idx = [slice(None)] * len(shard_shape)
+            idx[bdim] = slice(j, j + 1)
+            idx[adim] = slice(start, start + width)
+            return s[tuple(idx)]
+
+        def take(*shards):
+            out = []
+            if send_hi:
+                hi = pack_slabs([slab(s, c - 1, off + sz_last - rm, rm)
+                                 for s in shards])
+                out.append(hi.astype(wire) if wire is not None else hi)
+            if send_lo:
+                lo = pack_slabs([slab(s, 0, off, rp) for s in shards])
+                out.append(lo.astype(wire) if wire is not None else lo)
+            return tuple(out)
+
+        return take
+
+    def _update_fn(self, sizes, shard_shape, dtype, nq, wire,
+                   has_lo, has_hi):
+        """update(*shards, carriers...) -> new shards: write the
+        received DCN carriers into this segment-edge device's halos —
+        the low halo of its FIRST resident (``has_lo``, from the -axis
+        host) and/or the high halo of its LAST resident (``has_hi``),
+        widened back from the wire dtype."""
+        ph = self._phase
+        rm, rp, off, adim, bdim, c = (ph.rm, ph.rp, ph.offset, ph.adim,
+                                      ph.bdim, ph.resident)
+
+        def put(s, piece, j, start, width):
+            idx = [slice(None)] * len(shard_shape)
+            idx[bdim] = slice(j, j + 1)
+            idx[adim] = slice(start, start + width)
+            return s.at[tuple(idx)].set(piece)
+
+        def update(*args):
+            shards = list(args[:nq])
+            rest = list(args[nq:])
+            lo_q = hi_q = None
+            if has_lo:
+                lo = rest.pop(0)
+                if wire is not None:
+                    lo = lo.astype(dtype)
+                lo_q = unpack_slabs(lo, nq)
+            if has_hi:
+                hi = rest.pop(0)
+                if wire is not None:
+                    hi = hi.astype(dtype)
+                hi_q = unpack_slabs(hi, nq)
+            out = []
+            for q, s in enumerate(shards):
+                o = s
+                if has_lo:
+                    o = put(o, lo_q[q], 0, off - rm, rm)
+                if has_hi:
+                    o = put(o, hi_q[q], c - 1, off + sizes[c - 1], rp)
+                out.append(o)
+            return tuple(out)
+
+        return update
+
+    # -- the DCN level --------------------------------------------------------
+    def _groups(self, leaves) -> List[Tuple[object, List[int]]]:
+        if not self.ex.batch_quantities:
+            return [(leaves[i].dtype, [i]) for i in range(len(leaves))]
+        groups: Dict[object, List[int]] = {}
+        for i, leaf in enumerate(leaves):
+            groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+        return list(groups.items())
+
+    def _shards_by_coords(self, leaf):
+        out = {}
+        for sh in leaf.addressable_shards:
+            out[self._coords[sh.device.id]] = sh.data
+        return out
+
+    def dcn_start(self, state):
+        """Extract every cross-host boundary slab and START its copy
+        toward the far side (``device_put``, issued but not synced — the
+        caller's inner program dispatches while they fly). Returns the
+        pending structure :meth:`dcn_apply` consumes."""
+        leaves, _ = jax.tree.flatten(state)
+        mdevs = self.mesh.devices
+        ph = self._phase
+        pending = {"sharding": self.ex.sharding(), "groups": []}
+        for dtype, idxs in self._groups(leaves):
+            nq = len(idxs)
+            wire = wire_narrow_dtype(dtype, self.ex.wire_dtype)
+            shards = [self._shards_by_coords(leaves[i]) for i in idxs]
+            recv: Dict[Tuple[int, int, int], dict] = {}
+            for coords in shards[0]:
+                i = coords[self._axis_of]
+                send_hi = ph.rm > 0 and i % self.seg == self.seg - 1
+                send_lo = ph.rp > 0 and i % self.seg == 0
+                if not (send_hi or send_lo):
+                    continue
+                sizes = self._device_sizes(i)
+                args = tuple(s[coords] for s in shards)
+                key = ("take", sizes, args[0].shape, str(dtype), nq,
+                       str(wire), send_hi, send_lo)
+                fn = self._jit(key, lambda: self._take_fn(
+                    sizes, args[0].shape, nq, wire, send_hi, send_lo))
+                self._remember(key, args)
+                out = list(fn(*args))
+                if send_hi:
+                    # +axis: fills the low halo of the NEXT segment's
+                    # first device (the flat ring pair the host-local
+                    # wrap dropped)
+                    dst = list(coords)
+                    dst[self._axis_of] = (i + 1) % self.m
+                    dst = tuple(dst)
+                    car = jax.device_put(out.pop(0), mdevs[dst])
+                    self.last_transfer_count += 1
+                    self.last_transfer_bytes += int(car.nbytes)
+                    recv.setdefault(dst, {})["lo"] = car
+                if send_lo:
+                    dst = list(coords)
+                    dst[self._axis_of] = (i - 1) % self.m
+                    dst = tuple(dst)
+                    car = jax.device_put(out.pop(0), mdevs[dst])
+                    self.last_transfer_count += 1
+                    self.last_transfer_bytes += int(car.nbytes)
+                    recv.setdefault(dst, {})["hi"] = car
+            pending["groups"].append((dtype, idxs, recv))
+        return pending
+
+    def dcn_wait(self, pending) -> None:
+        """Block until every started DCN copy has landed — the
+        recv-semaphore wait of the overlap schedule."""
+        for _dt, _idxs, recv in pending["groups"]:
+            for per_dev in recv.values():
+                for car in per_dev.values():
+                    jax.block_until_ready(car)
+
+    def dcn_apply(self, state, pending):
+        """Wait, then write every arrived carrier into its receiver's
+        DCN-axis halos (compiled updates, zero collectives) and
+        reassemble the state."""
+        self.dcn_wait(pending)
+        leaves, treedef = jax.tree.flatten(state)
+        order = [self._coords[d.id] for d in self.mesh.devices.flat]
+        sharding = pending["sharding"]
+        for dtype, idxs, recv in pending["groups"]:
+            if not recv:
+                continue
+            nq = len(idxs)
+            wire = wire_narrow_dtype(dtype, self.ex.wire_dtype)
+            shards = [self._shards_by_coords(leaves[i]) for i in idxs]
+            new_shards: Dict[Tuple[int, int, int], tuple] = {}
+            for coords, per in recv.items():
+                i = coords[self._axis_of]
+                sizes = self._device_sizes(i)
+                args = tuple(s[coords] for s in shards)
+                has_lo, has_hi = "lo" in per, "hi" in per
+                carriers = ([per["lo"]] if has_lo else []) \
+                    + ([per["hi"]] if has_hi else [])
+                key = ("upd", sizes, args[0].shape, str(dtype), nq,
+                       str(wire), has_lo, has_hi)
+                fn = self._jit(key, lambda: self._update_fn(
+                    sizes, args[0].shape, dtype, nq, wire, has_lo,
+                    has_hi))
+                self._remember(key, tuple(args) + tuple(carriers))
+                new_shards[coords] = fn(*args, *carriers)
+            for q, li in enumerate(idxs):
+                leaves[li] = jax.make_array_from_single_device_arrays(
+                    leaves[li].shape, sharding,
+                    [new_shards[c][q] if c in new_shards
+                     else shards[q][c] for c in order],
+                )
+        return jax.tree.unflatten(treedef, leaves)
+
+    # -- the inner programs ---------------------------------------------------
+    @cached_property
+    def _program_a(self):
+        """The DCN-axis inner phase alone (host-local wrap pairs) — the
+        compiled intra-host work the started DCN copies hide behind."""
+        ax = (self.axis,)
+        fn = jax.shard_map(
+            lambda s: self.ex.exchange_blocks(s, axes=ax),
+            mesh=self.mesh, in_specs=BLOCK_PSPEC, out_specs=BLOCK_PSPEC,
+        )
+        return jax.jit(fn, donate_argnums=0)
+
+    @cached_property
+    def _program_b(self):
+        """The remaining axis phases, run after the DCN apply: their
+        full-padded-extent slabs overwrite every stale strip the early
+        DCN slabs carried."""
+        rest = tuple(p.axis for p in self.plan.axis_phases
+                     if p.axis != self.axis)
+        fn = jax.shard_map(
+            lambda s: self.ex.exchange_blocks(s, axes=rest),
+            mesh=self.mesh, in_specs=BLOCK_PSPEC, out_specs=BLOCK_PSPEC,
+        )
+        return jax.jit(fn, donate_argnums=0)
+
+    # -- one exchange ---------------------------------------------------------
+    def __call__(self, state):
+        with timer.timed("exchange.hierarchy"), \
+                timer.trace_range("exchange.hierarchical"):
+            self.last_transfer_count = 0
+            self.last_transfer_bytes = 0
+            if self._composed:
+                return self._overlapped(state)
+            return self._sequential(state)
+
+    def _overlapped(self, state):
+        """Boundary-first with overlap: start the DCN copies from the
+        pre-exchange state, hide them behind the compiled DCN-axis
+        phase, apply, then finish the other two phases."""
+        pending = self.dcn_start(state)
+        state = self._program_a(state)
+        state = self.dcn_apply(state, pending)
+        return self._program_b(state)
+
+    def _sequential(self, state):
+        """Opaque-inner schedule (REMOTE_DMA family): full inner
+        exchange first (host-segmented neighbor arithmetic), then one
+        post-inner slab per segment boundary fixes the receiver's whole
+        DCN-axis halo side — face, edges and corners in one apply."""
+        state = self.ex._remote(state)
+        pending = self.dcn_start(state)
+        return self.dcn_apply(state, pending)
+
+    # -- loops / census -------------------------------------------------------
+    def make_loop(self, iters: int):
+        """``iters`` back-to-back hierarchical exchanges. A host loop —
+        the DCN level is host-orchestrated, so there is no single
+        compiled program to fuse (same shape as the REMOTE_DMA
+        emulation's loop)."""
+
+        def loop(state):
+            for _ in range(iters):
+                state = self(state)
+            return state
+
+        return loop
+
+    def collective_census(self, state):
+        """Census over EVERY compiled piece of one hierarchical
+        exchange: the inner programs (whose ppermute count and bytes
+        equal the flat plan's — the unchanged inner pin) plus the DCN
+        take/update programs (zero collectives by construction)."""
+        from ..utils.hlo_check import collective_census
+
+        # run one exchange on a COPY to build every piece: the inner
+        # programs donate their inputs, and the caller keeps its state
+        self(jax.tree.map(jnp.copy, state))
+        total: Dict[str, Tuple[int, int]] = {}
+
+        def merge(census):
+            for kind, (c, b) in census.items():
+                c0, b0 = total.get(kind, (0, 0))
+                total[kind] = (c0 + c, b0 + b)
+
+        if self._composed:
+            for prog in (self._program_a, self._program_b):
+                merge(collective_census(
+                    prog.lower(state).compile().as_text()))
+        else:
+            merge(self.ex._remote.collective_census(state))
+        for key, fn in self._jits.items():
+            avals = self._avals.get(key)
+            if avals is None:
+                continue
+            merge(collective_census(
+                fn.lower(*avals).compile().as_text()))
+        return total

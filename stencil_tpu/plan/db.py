@@ -99,6 +99,27 @@ def validate_entry(key: str, entry) -> List[str]:
     perr = validate_placement(choice.placement, cfg.ndev)
     if perr is not None:
         errs.append(f"entry {key!r}: {perr}")
+    # hierarchy/host_placement ride the same absent-field migration:
+    # every pre-hierarchy entry deserializes to None (flat) and replays
+    # unchanged; a present hierarchy must be a valid (axis, hosts) split
+    # of the choice's partition, a present host_placement a permutation
+    # of range(hosts)
+    if choice.hierarchy is not None:
+        from ..geometry import Dim3
+        from .ir import validate_hierarchy
+
+        px, py, pz = choice.partition
+        herr = validate_hierarchy(choice.hierarchy, Dim3(px, py, pz))
+        if herr is not None:
+            errs.append(f"entry {key!r}: {herr}")
+    if choice.host_placement is not None:
+        hp = list(choice.host_placement)
+        hosts = choice.hierarchy[1] if choice.hierarchy is not None else None
+        if hosts is None:
+            errs.append(f"entry {key!r}: host_placement without hierarchy")
+        elif sorted(hp) != list(range(hosts)):
+            errs.append(f"entry {key!r}: host_placement {hp} is not a "
+                        f"permutation of range({hosts})")
     if entry.get("source") not in SOURCES:
         errs.append(f"entry {key!r}: unknown source {entry.get('source')!r}")
     for fld in ("static_cost_s", "measured_s"):

@@ -1,0 +1,343 @@
+"""Hierarchical ICI+DCN halo exchange (ISSUE 17 / ROADMAP #3).
+
+The claims under test, all on the in-process virtual-host fabric
+(``STENCIL_VIRTUAL_HOSTS`` — id-sorted contiguous device groups, set
+per-test via monkeypatch, no env-dependent skips):
+
+- **bit parity**: the two-level lowering (cross-host DCN-axis slabs as
+  host-orchestrated carrier copies started before the inner per-host
+  programs) is bit-identical to the flat plan on uniform, uneven, and
+  oversubscribed partitions, fp32/fp64/mixed dicts, bf16 wire, batch
+  off, through axis-composed / remote-dma / fused inner transports,
+  and through the full jacobi step loop.
+- **census pins unchanged**: the hierarchical census's
+  collective-permute (count, bytes) equals the flat plan's, and the
+  DCN level contributes zero collectives of any kind.
+- **predicted == executed**: ``DcnPhaseIR``'s
+  ``dcn_transfers_per_exchange`` / ``dcn_wire_bytes`` match the
+  executed ``last_transfer_count`` / ``last_transfer_bytes`` exactly.
+- **alignment is validated**: a split whose segments interleave across
+  hosts (an x split under identity device order) raises, and the
+  composed two-level placement ordering fixes it.
+- **the auditor audits**: ``analysis/verify_plan.run_hierarchy_sweep``
+  passes clean and trips on a perturbed DCN prediction.
+- **ckpt topology delta**: manifests record the host->blocks map; a
+  restore under a different host fabric warns, a pre-hierarchy
+  snapshot stays quiet.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.domain.grid import GridSpec
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel import HaloExchange, Method, grid_mesh
+from stencil_tpu.parallel.device_topo import host_assignment, virtual_hosts
+from stencil_tpu.parallel.exchange import shard_blocks
+
+VH = "STENCIL_VIRTUAL_HOSTS"
+
+
+def _state(spec, mesh, nq=1, dtypes=None):
+    g = spec.global_size
+    base = (
+        np.arange(g.z)[:, None, None] * 1_000_000.0
+        + np.arange(g.y)[None, :, None] * 1_000.0
+        + np.arange(g.x)[None, None, :]
+    )
+    return {
+        i: shard_blocks(
+            (base + i).astype(dtypes[i] if dtypes else np.float32),
+            spec, mesh)
+        for i in range(nq)
+    }
+
+
+def _gather(state):
+    return np.stack(
+        [np.asarray(jax.device_get(state[i]), dtype=np.float64)
+         for i in sorted(state)]
+    )
+
+
+def _pair(spec, mesh_dim, ndev, hierarchy, method=Method.AXIS_COMPOSED,
+          **kw):
+    """(flat exchange, hierarchical exchange) on the same device list."""
+    devs = jax.devices()[:ndev]
+    flat = HaloExchange(spec, grid_mesh(mesh_dim, devs), method, **kw)
+    hier = HaloExchange(spec, grid_mesh(mesh_dim, devs), method,
+                        hierarchy=hierarchy, **kw)
+    return flat, hier
+
+
+# -- fabric ---------------------------------------------------------------
+
+
+def test_virtual_hosts_env_partitions_devices(monkeypatch):
+    monkeypatch.delenv(VH, raising=False)
+    assert virtual_hosts() == 0
+    devs = jax.devices()[:8]
+    assert set(host_assignment(devs)) == {0}
+    monkeypatch.setenv(VH, "2")
+    assert virtual_hosts() == 2
+    assign = host_assignment(devs)
+    assert assign == sorted(assign) and set(assign) == {0, 1}
+    assert assign.count(0) == assign.count(1) == 4
+
+
+# -- bit parity: one exchange ---------------------------------------------
+
+
+CASES = [
+    # (global, partition, mesh_dim, ndev, hierarchy)
+    ((16, 16, 16), (2, 2, 2), (2, 2, 2), 8, ("z", 2)),      # uniform
+    ((14, 18, 20), (1, 2, 4), (1, 2, 4), 8, ("z", 2)),      # uneven, z4/h2
+    ((16, 16, 16), (1, 2, 4), (1, 2, 4), 8, ("z", 4)),      # 4 hosts
+    ((12, 12, 16), (2, 2, 4), (1, 2, 2), 4, ("z", 2)),      # oversubscribed
+]
+
+
+@pytest.mark.parametrize("g,part,mdim,ndev,hier", CASES)
+@pytest.mark.parametrize("method,kw", [
+    (Method.AXIS_COMPOSED, {}),
+    (Method.REMOTE_DMA, {}),
+    (Method.REMOTE_DMA, {"fused": True}),
+])
+def test_hierarchical_bit_identical_to_flat(monkeypatch, g, part, mdim,
+                                            ndev, hier, method, kw):
+    monkeypatch.setenv(VH, str(hier[1]))
+    spec = GridSpec(Dim3(*g), Dim3(*part), Radius.constant(2))
+    if (method, tuple(kw)) != (Method.AXIS_COMPOSED, ()) and mdim != part:
+        pytest.skip("remote-dma/fused emulations are single-resident")
+    flat, hx = _pair(spec, Dim3(*mdim), ndev, hier, method, **kw)
+    state = _state(spec, flat.mesh, nq=2)
+    np.testing.assert_array_equal(
+        _gather(flat(jax.tree.map(jnp.copy, state))),
+        _gather(hx(jax.tree.map(jnp.copy, state))))
+
+
+@pytest.mark.parametrize("dtypes", [
+    [np.float64, np.float64],
+    [np.float32, np.float64, np.float32],   # mixed: two dtype groups
+])
+def test_hierarchical_parity_fp64_and_mixed(monkeypatch, dtypes):
+    monkeypatch.setenv(VH, "2")
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    flat, hx = _pair(spec, Dim3(2, 2, 2), 8, ("z", 2))
+    state = _state(spec, flat.mesh, nq=len(dtypes), dtypes=dtypes)
+    a = flat(jax.tree.map(jnp.copy, state))
+    b = hx(jax.tree.map(jnp.copy, state))
+    for i in state:
+        ga, gb = jax.device_get(a[i]), jax.device_get(b[i])
+        assert ga.dtype == gb.dtype == dtypes[i]
+        np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb))
+
+
+@pytest.mark.parametrize("kw", [
+    {"wire_dtype": "bfloat16"},
+    {"batch_quantities": False},
+])
+def test_hierarchical_parity_wire_and_batch_knobs(monkeypatch, kw):
+    monkeypatch.setenv(VH, "2")
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    flat, hx = _pair(spec, Dim3(2, 2, 2), 8, ("z", 2), **kw)
+    state = _state(spec, flat.mesh, nq=2)
+    np.testing.assert_array_equal(
+        _gather(flat(jax.tree.map(jnp.copy, state))),
+        _gather(hx(jax.tree.map(jnp.copy, state))))
+
+
+def test_hierarchical_step_loop_parity(monkeypatch):
+    """5 jacobi iterations land bit-identical to the flat plan (the DCN
+    exchange inside the compute loop, overlap path included)."""
+    from stencil_tpu.ops.jacobi import make_jacobi_loop, sphere_masks
+
+    monkeypatch.setenv(VH, "2")
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(2))
+    g = spec.global_size
+    rng = np.random.default_rng(0)
+    curr = rng.standard_normal((g.z, g.y, g.x)).astype(np.float32)
+    hot, cold = sphere_masks(g)
+    sel = np.zeros((g.z, g.y, g.x), np.float32)
+    sel[hot] = 1
+    sel[cold] = 2
+
+    outs = {}
+    for tag, hier in (("flat", None), ("hier", ("z", 2))):
+        mesh = grid_mesh(spec.dim, jax.devices()[:8])
+        ex = HaloExchange(spec, mesh, hierarchy=hier)
+        loop = make_jacobi_loop(ex, 5)
+        out, _ = loop(shard_blocks(curr, spec, mesh),
+                      shard_blocks(np.zeros_like(curr), spec, mesh),
+                      shard_blocks(sel, spec, mesh))
+        outs[tag] = np.asarray(jax.device_get(out))
+    np.testing.assert_array_equal(outs["flat"], outs["hier"])
+
+
+# -- census + counters ----------------------------------------------------
+
+
+def test_inner_census_pins_unchanged_and_dcn_collective_free(monkeypatch):
+    monkeypatch.setenv(VH, "2")
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(1, 2, 4), Radius.constant(2))
+    flat, hx = _pair(spec, Dim3(1, 2, 4), 8, ("z", 2))
+    state = _state(spec, flat.mesh, nq=2)
+    cf = flat.collective_census(state)
+    ch = hx.collective_census(state)
+    assert ch.get("collective-permute") == cf.get("collective-permute")
+    stray = {k: v for k, v in ch.items()
+             if k != "collective-permute" and v[0]}
+    assert stray == {}, stray
+
+
+def test_predicted_dcn_transfers_and_bytes_match_executed(monkeypatch):
+    monkeypatch.setenv(VH, "2")
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(1, 2, 4), Radius.constant(2))
+    _, hx = _pair(spec, Dim3(1, 2, 4), 8, ("z", 2))
+    dtypes = [np.float32, np.float64]
+    state = _state(spec, hx.mesh, nq=2, dtypes=dtypes)
+    hx(jax.tree.map(jnp.copy, state))
+    plan = hx.plan
+    ngroups = 2  # two dtype groups
+    assert plan.dcn_transfers_per_exchange(2, ngroups) > 0
+    assert (hx._compiled.last_transfer_count
+            == plan.dcn_transfers_per_exchange(2, ngroups))
+    assert (hx._compiled.last_transfer_bytes
+            == plan.dcn_wire_bytes([4, 8], floating=[True, True]))
+
+
+def test_dcn_counters_reset_per_exchange(monkeypatch):
+    monkeypatch.setenv(VH, "2")
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(1))
+    _, hx = _pair(spec, Dim3(2, 2, 2), 8, ("z", 2))
+    state = _state(spec, hx.mesh)
+    out = hx(jax.tree.map(jnp.copy, state))
+    c, b = hx._compiled.last_transfer_count, hx._compiled.last_transfer_bytes
+    hx(out)
+    assert (hx._compiled.last_transfer_count,
+            hx._compiled.last_transfer_bytes) == (c, b)
+
+
+# -- alignment ------------------------------------------------------------
+
+
+def test_misaligned_split_raises_and_composed_order_fixes_it(monkeypatch):
+    """An x split under identity device order interleaves its segments
+    across the id-sorted contiguous hosts -> loud ValueError naming the
+    fabric; reordering the device list so each segment lives on one
+    host (what realize() does with the two-level placement) builds and
+    stays bit-identical to flat."""
+    monkeypatch.setenv(VH, "2")
+    spec = GridSpec(Dim3(16, 16, 16), Dim3(2, 2, 2), Radius.constant(1))
+    devs = jax.devices()[:8]
+    bad = HaloExchange(spec, grid_mesh(spec.dim, devs), hierarchy=("x", 2))
+    state = _state(spec, bad.mesh, nq=1)
+    with pytest.raises(ValueError, match="do not align"):
+        bad(state)  # the two-level lowering validates at first build
+
+    # mesh flat order is (z, y, x) with x fastest: put host-0 devices on
+    # every x=0 slot and host-1 devices on every x=1 slot
+    order = [devs[i // 2] if i % 2 == 0 else devs[4 + i // 2]
+             for i in range(8)]
+    mesh = grid_mesh(spec.dim, order, ordered=True)
+    hx = HaloExchange(spec, mesh, hierarchy=("x", 2))
+    flat = HaloExchange(spec, mesh)
+    state = _state(spec, mesh, nq=1)
+    np.testing.assert_array_equal(
+        _gather(flat(jax.tree.map(jnp.copy, state))),
+        _gather(hx(jax.tree.map(jnp.copy, state))))
+
+
+def test_hierarchy_validation_rejects_bad_split():
+    from stencil_tpu.plan.ir import validate_hierarchy
+
+    assert validate_hierarchy(("z", 2), Dim3(2, 2, 2)) is None
+    assert validate_hierarchy(("z", 3), Dim3(2, 2, 2)) is not None
+    assert validate_hierarchy(("q", 2), Dim3(2, 2, 2)) is not None
+
+
+# -- the auditor ----------------------------------------------------------
+
+
+def test_verify_plan_hierarchy_sweep_clean_and_perturb_trips(monkeypatch):
+    from stencil_tpu.analysis import verify_plan as vp
+
+    monkeypatch.delenv(VH, raising=False)
+    cfgs = vp.hierarchy_sweep_configs(
+        size=16, radius=2, partitions=[(1, 2, 4)],
+        methods=["axis-composed"], qsets=[("float32", "float64")])
+    res = vp.run_hierarchy_sweep(
+        hosts=2, size=16, radius=2, partitions=[(1, 2, 4)],
+        methods=["axis-composed"], qsets=[("float32", "float64")])
+    assert res["checked"] == len(cfgs) >= 1
+    assert res["failed"] == 0, [v.to_json() for v in res["verdicts"]]
+    names = {c["name"] for v in res["verdicts"] for c in v.checks}
+    assert {"dcn_transfers", "dcn_wire_bytes", "inner_census_pin",
+            "bit_identical_to_flat"} <= names
+    # the sweep owns the env flip and restores it
+    assert VH not in os.environ
+
+    res = vp.run_hierarchy_sweep(
+        hosts=2, size=16, radius=2, partitions=[(1, 2, 4)],
+        methods=["axis-composed"], qsets=[("float32", "float64")],
+        perturb_dcn=1)
+    assert res["failed"] == res["checked"] >= 1
+
+
+# -- ckpt host-topology delta ---------------------------------------------
+
+
+def _realized_dd(monkeypatch, hosts):
+    from stencil_tpu.api import DistributedDomain
+
+    if hosts:
+        monkeypatch.setenv(VH, str(hosts))
+    else:
+        monkeypatch.delenv(VH, raising=False)
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_radius(1)
+    dd.set_devices(jax.devices()[:8])
+    dd.add_data("q", "float32")
+    dd.realize()
+    return dd
+
+
+def test_manifest_records_host_blocks(monkeypatch):
+    dd = _realized_dd(monkeypatch, 2)
+    hosts = dd.plan_meta()["host_blocks"]
+    assert len(hosts) == 8 and set(hosts) == {0, 1}
+
+
+def test_ckpt_warns_on_host_topology_delta(monkeypatch, capfd):
+    dd = _realized_dd(monkeypatch, 2)
+    manifest = {"meta": {"plan": dd.plan_meta()}}
+    other = _realized_dd(monkeypatch, 4)
+    capfd.readouterr()
+    other._warn_plan_mismatch(manifest)
+    err = capfd.readouterr().err
+    assert "host fabric" in err
+
+
+def test_ckpt_quiet_on_same_fabric_and_pre_hierarchy_snapshot(monkeypatch,
+                                                              capfd):
+    dd = _realized_dd(monkeypatch, 2)
+    manifest = {"meta": {"plan": dd.plan_meta()}}
+    capfd.readouterr()
+    dd._warn_plan_mismatch(manifest)
+    assert capfd.readouterr().err == ""
+
+    # a pre-hierarchy snapshot (no host_blocks / hierarchy keys at all)
+    # must not warn against a flat single-host run
+    old = _realized_dd(monkeypatch, 0)
+    manifest = {"meta": {"plan": old.plan_meta()}}
+    for k in ("host_blocks",):
+        del manifest["meta"]["plan"][k]
+    for k in ("hierarchy", "host_placement"):
+        del manifest["meta"]["plan"]["choice"][k]
+    capfd.readouterr()
+    old._warn_plan_mismatch(manifest)
+    assert capfd.readouterr().err == ""
