@@ -425,6 +425,8 @@ def _child_main(mode: str, resume: bool = False) -> int:
     plan_tuned_gb_s = 0.0
     plan_default_gb_s = 0.0
     plan_label = None
+    plan_fingerprint = None
+    plan_calibration = None
     if leg("exchange plan autotune"):
         try:
             from stencil_tpu.plan.autotune import autotune, default_choice
@@ -437,6 +439,10 @@ def _child_main(mode: str, resume: bool = False) -> int:
             )
             ch = res.choice
             plan_label = ch.label()
+            # the plan identity the observatory joins on: which exact
+            # PlanChoice produced this leg, priced by which calibration
+            plan_fingerprint = ch.fingerprint()
+            plan_calibration = res.calibration_provenance
             from stencil_tpu.parallel import Method as _M
 
             plan_tuned_gb_s = _exchange_leg(
@@ -628,6 +634,8 @@ def _child_main(mode: str, resume: bool = False) -> int:
             if plan_default_gb_s else 0.0
         ),
         "plan_choice": plan_label,
+        "plan_fingerprint": plan_fingerprint,
+        "plan_calibration": plan_calibration,
         # multi-tenant campaign leg: one batched program serving B=64
         # 32^3 tenants over the sequential baseline (> 1: batching wins),
         # with the per-tenant step-latency tail (utils/statistics

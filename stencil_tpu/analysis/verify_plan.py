@@ -726,6 +726,154 @@ def run_hierarchy_sweep(
             jax.config.update("jax_enable_x64", False)
 
 
+# -- timed audit (the ISSUE-18 drift leg: seconds, not just structure) -------
+
+
+def audit_time(cfg: dict, devices=None, iters: int = 6,
+               calibration: Optional[dict] = None,
+               mad_k: float = 3.0, rel_tol: float = 0.75,
+               rec: Optional["telemetry.Recorder"] = None,
+               slow_s: float = 0.0) -> Verdict:
+    """Time one config's exchange and judge the cost model's PREDICTION
+    against the measured samples' band (``obs/attribution.judge_drift``
+    — the perf_tool band authority). The structural audits check what
+    the lowering puts on the wire; this one checks the seconds the
+    autotuner ranked it by.
+
+    The default ``rel_tol`` is wide (0.75 — "within [0.25x, 1.75x] of
+    measured"): a handful of in-process samples on a shared CPU box
+    judges multiple-x calibration staleness, not 5% drift; tighten it
+    on quiet fabrics, but keep it below 1 (at 1 the low band edge hits
+    zero and an under-prediction can never trip).
+    ``slow_s`` sleeps that long inside ONE timed iteration — the CI
+    proof knob that the timed auditor trips, like ``perturb_*`` for the
+    structural checks."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from ..geometry import Dim3, Radius
+    from ..obs import attribution
+    from ..parallel import HaloExchange, Method, grid_mesh
+    from ..parallel.exchange import shard_blocks
+    from ..plan.cost import feasible
+    from ..plan.ir import (FUSED_VARIANT, PERSISTENT_VARIANT, PlanChoice,
+                           PlanConfig, REMOTE_DMA)
+    from ..utils.sync import hard_sync
+
+    rec = rec or telemetry.get()
+    devices = list(devices) if devices is not None else jax.devices()
+    v = Verdict(label=cfg["label"], method=cfg["method"])
+    fused = cfg["method"] == FUSED_METHOD_LABEL
+    persistent = cfg["method"] == PERSISTENT_METHOD_LABEL
+    method = REMOTE_DMA if (fused or persistent) else cfg["method"]
+    size, dtypes = cfg["size"], list(cfg["dtypes"])
+    radius = Radius.constant(cfg["radius"])
+    nblocks = cfg["partition"][0] * cfg["partition"][1] * cfg["partition"][2]
+    if nblocks > len(devices):
+        v.skipped = True
+        v.ok = False
+        v.reason = (f"partition {cfg['partition']} needs {nblocks} "
+                    f"devices; {len(devices)} available")
+        return v
+    config = PlanConfig.make(Dim3(size, size, size), radius, dtypes,
+                             nblocks, devices[0].platform)
+    choice = PlanChoice(
+        partition=cfg["partition"], method=method,
+        kernel_variant=(PERSISTENT_VARIANT if persistent
+                        else FUSED_VARIANT if fused else None),
+        multistep_k=2 if persistent else 1)
+    feas = feasible(config, choice)
+    if feas is None:
+        v.skipped = True
+        v.ok = False
+        v.reason = "infeasible for this config (plan/cost.feasible)"
+        return v
+    pred = attribution.predict_exchange(config, choice, calibration)
+    if pred is None:
+        v.skipped = True
+        v.ok = False
+        v.reason = "cost model prices this choice as infeasible"
+        return v
+    spec, mesh_dim, _resident = feas
+    mesh = grid_mesh(spec.dim, devices[:nblocks])
+    ex = HaloExchange(spec, mesh, Method(method), fused=fused,
+                      persistent=persistent)
+    g = spec.global_size
+    base = np.arange(g.x * g.y * g.z, dtype=np.float64).reshape(
+        g.z, g.y, g.x)
+    state = {i: shard_blocks((base + i).astype(dt), spec, mesh)
+             for i, dt in enumerate(dtypes)}
+    state = ex(state)  # compile + warm outside the timed window
+    hard_sync(state)
+    samples: List[float] = []
+    for i in range(max(2, iters)):
+        t0 = _time.perf_counter()
+        state = ex(state)
+        hard_sync(state)
+        if slow_s and i == 0:
+            _time.sleep(slow_s)  # the seeded-staleness proof knob
+        samples.append(_time.perf_counter() - t0)
+        attribution.emit_phase(rec, pred, samples[-1],
+                               phase="stencil.exchange",
+                               kernel_variant=choice.kernel_variant)
+    dv = attribution.judge_drift("stencil.exchange", pred.predicted_s,
+                                 samples, mad_k=mad_k, rel_tol=rel_tol)
+    attribution.emit_drift(rec, dv)
+    v.checks.append({
+        "name": "predicted_s_within_band",
+        "predicted": f"{dv.predicted_s:.3e}s",
+        "actual": f"measured band [{dv.lo:.3e}, {dv.hi:.3e}] "
+                  f"(center {dv.center:.3e}s, n={dv.n})",
+        "ok": dv.ok,
+    })
+    v.ok = bool(dv.ok)
+    if not dv.ok:
+        v.reason = dv.describe()
+    return v
+
+
+def run_time_sweep(configs: Sequence[dict], devices=None,
+                   iters: int = 6, calibration: Optional[dict] = None,
+                   mad_k: float = 3.0, rel_tol: float = 0.75,
+                   slow_s: float = 0.0,
+                   rec: Optional["telemetry.Recorder"] = None) -> Dict:
+    """Timed-audit every config; same result/telemetry shape as
+    :func:`run_sweep` (one ``analysis.plan_verdict`` per config, the
+    ``analysis.plan_sweep`` rollup)."""
+    rec = rec or telemetry.get()
+    verdicts: List[Verdict] = []
+    for cfg in configs:
+        with rec.span("analysis.verify_plan", phase="analysis",
+                      method=cfg["method"]):
+            try:
+                v = audit_time(cfg, devices=devices, iters=iters,
+                               calibration=calibration, mad_k=mad_k,
+                               rel_tol=rel_tol, rec=rec, slow_s=slow_s)
+            except Exception as e:  # an auditor crash is a FAILED config
+                v = Verdict(label=cfg["label"], method=cfg["method"],
+                            ok=False, reason=f"{type(e).__name__}: {e}")
+        verdicts.append(v)
+        rec.meta("analysis.plan_verdict", method=v.method,
+                 ok=int(v.ok), label=v.label,
+                 skipped=int(v.skipped), reason=v.reason or None)
+        if not v.ok and not v.skipped:
+            rec.counter("analysis.plan_mismatch", value=1,
+                        phase="analysis", method=v.method)
+    checked = [v for v in verdicts if not v.skipped]
+    failed = [v for v in checked if not v.ok]
+    skipped = [v for v in verdicts if v.skipped]
+    rec.meta("analysis.plan_sweep", checked=len(checked),
+             failed=len(failed), skipped=len(skipped))
+    return {
+        "verdicts": verdicts,
+        "checked": len(checked),
+        "failed": len(failed),
+        "skipped": len(skipped),
+    }
+
+
 def run_sweep(configs: Sequence[dict], devices=None,
               perturb_collectives: int = 0, perturb_wire: int = 0,
               perturb_dmas: int = 0,

@@ -307,6 +307,7 @@ def time_exchange(
             **wtag)
 
     stats = Statistics()
+    samples = []
     done = 0
     while done < iters:
         k = min(chunk, iters - done)
@@ -315,11 +316,37 @@ def time_exchange(
         hard_sync(state)
         per = (time.perf_counter() - t0) / k
         stats.insert(per)
+        samples.append(per)
         rec.emit("span", "exchange.iter", phase="exchange", seconds=per,
                  iters=k, method=method.value, batched=batch_quantities,
                  **wtag)
         done += k
     dd._curr = dict(state)  # the loops donated the original buffers
+    if rec.enabled:
+        # per-phase attribution: pair the installed cost model's
+        # prediction for THIS realized plan with the measured samples
+        # above — one plan.attrib.phase record per sample, the raw
+        # material of `plan_tool calibrate` and `perf_tool drift`
+        from ..obs import attribution
+        from ..plan.ir import PlanChoice, PlanConfig
+        from .machine_info import fabric_fingerprint
+
+        pm = dd.plan_meta()
+        pchoice = PlanChoice.from_json(pm["choice"])
+        attribution.attribute_and_judge(
+            rec,
+            PlanConfig.from_json(pm["key"]),
+            pchoice,
+            samples,
+            phase="exchange.iter",
+            kernel_variant="fused" if fused else None,
+            fabric=fabric_fingerprint(devices=devices),
+        )
+        # the run's plan identity — the join key between this metrics
+        # file, the plan DB, and any fitted calibration row
+        rec.meta("plan.fingerprint", fingerprint=pchoice.fingerprint(),
+                 choice=pchoice.label(), calibration="modeled(default)",
+                 **wtag)
     if rec.enabled:
         rec.gauge("exchange.trimean_s", stats.trimean(), phase="exchange",
                   unit="s", method=method.value, batched=batch_quantities,

@@ -423,13 +423,55 @@ def run(
         with rec.span("jacobi.exchange_warmup", phase="compile"):
             st = exch_loop(st)
             hard_sync(st)
-        for _ in range(3):
+        # slow@ injections scheduled PAST the step loop land inside the
+        # timed exchange window below (steps iters+1..iters+3, one per
+        # sample): `--inject slow@{iters+k}:seconds=S` inflates exactly
+        # one measured sample — the drift sentinel's trip-proof knob
+        # (scripts/ci_attrib_gate.py). Only slow faults fire here; state
+        # corruption stays confined to the guarded step loop.
+        slow_tail = None
+        if injector is not None:
+            tail = [i for i in injector.injections
+                    if i.kind == "slow" and i.step > iters]
+            if tail:
+                slow_tail = FaultPlan(tail, seed=injector.seed)
+        exch_samples = []
+        for i in range(3):
             t0 = time.perf_counter()
             st = exch_loop(st)
+            if slow_tail is not None:
+                st = slow_tail.fire_due(st, iters + i, iters + i + 1)
             hard_sync(st)
+            per = (time.perf_counter() - t0) / n_ex
+            exch_samples.append(per)
             rec.emit("span", "jacobi.exchange", phase="exchange",
-                     seconds=(time.perf_counter() - t0) / n_ex, iters=n_ex)
+                     seconds=per, iters=n_ex)
         curr = st[h.idx]
+        # per-phase attribution: pair the cost model's prediction for the
+        # realized plan with the measured exchange share — the autotuner's
+        # calibration (fitted, when the plan DB carries one) prices it, so
+        # the records judge the constants that actually ranked this plan
+        from ..obs import attribution
+        from ..plan.ir import PlanChoice, PlanConfig
+        from .machine_info import fabric_fingerprint
+
+        pm = dd.plan_meta()
+        plan_choice = PlanChoice.from_json(pm["choice"])
+        tuned = dd.autotune_result
+        attribution.attribute_and_judge(
+            rec, PlanConfig.from_json(pm["key"]), plan_choice,
+            exch_samples, phase="jacobi.exchange",
+            calibration=tuned.calibration if tuned is not None else None,
+            kernel_variant=plan_choice.kernel_variant,
+            fabric=fabric_fingerprint(devices=devices))
+        # the run's plan identity: which exact PlanChoice produced these
+        # numbers, under which calibration — the join key between a
+        # metrics file, the plan DB, and a fitted calibration row
+        rec.meta("plan.fingerprint",
+                 fingerprint=plan_choice.fingerprint(),
+                 choice=plan_choice.label(),
+                 calibration=(tuned.calibration_provenance
+                              if tuned is not None else "modeled(default)"))
         if metrics_dma:
             # static per-kernel HBM DMA truth from the compiled Mosaic
             # artifact (utils/mosaic_traffic) — only meaningful where the

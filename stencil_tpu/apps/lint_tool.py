@@ -228,6 +228,35 @@ def cmd_verify_plan(args) -> int:
             "failed": res["failed"] + hres["failed"],
             "skipped": res["skipped"] + hres["skipped"],
         }
+    if getattr(args, "time", 0):
+        calibration = None
+        if getattr(args, "time_db", ""):
+            import jax
+
+            from ..plan import db as plandb
+
+            db = plandb.load_db(args.time_db)
+            row = plandb.lookup_calibration(
+                db, jax.devices()[0].platform)
+            if row is not None:
+                calibration = row["calibration"]
+        # the timed grid is deliberately small (first partition, one
+        # f32 quantity, the base methods): it judges seconds, and
+        # wall-clock per config is iters x a real exchange
+        tconfigs = vp.sweep_configs(
+            size=args.size, radius=args.radius,
+            partitions=_parse_partitions(args.partitions)[:1],
+            methods=methods, qsets=(("float32",),))
+        tres = vp.run_time_sweep(tconfigs, iters=args.time,
+                                 calibration=calibration,
+                                 rel_tol=args.time_rel_tol,
+                                 slow_s=args.time_slow, rec=rec)
+        res = {
+            "verdicts": res["verdicts"] + tres["verdicts"],
+            "checked": res["checked"] + tres["checked"],
+            "failed": res["failed"] + tres["failed"],
+            "skipped": res["skipped"] + tres["skipped"],
+        }
     verdicts = res["verdicts"]
     if args.json:
         print(json.dumps({
@@ -377,6 +406,27 @@ def main(argv: Optional[list] = None) -> int:
                              "source_target_pairs == the plan's logical "
                              "schedule, results bit-identical to "
                              "identity (the ISSUE-15 placement gate)")
+        sp.add_argument("--time", type=int, default=0,
+                        help="ALSO time N exchange iterations per method "
+                             "on the first partition (single-f32 grid) "
+                             "and judge the cost model's predicted "
+                             "seconds against the measured trimean±MAD "
+                             "band — the calibration drift sentinel "
+                             "(the ISSUE-18 timed gate; 0 = off)")
+        sp.add_argument("--time-db", default="",
+                        help="plan DB whose installed fitted calibration "
+                             "prices the --time predictions (default: "
+                             "the modeled DEFAULT_CALIBRATION)")
+        sp.add_argument("--time-rel-tol", type=float, default=0.75,
+                        help="--time band floor as a fraction of the "
+                             "measured trimean (default 0.75 — wide: a "
+                             "few in-process samples judge multiple-x "
+                             "staleness, not 5%% drift; keep it < 1 or "
+                             "an under-prediction can never trip)")
+        sp.add_argument("--time-slow", type=float, default=0.0,
+                        help="sleep this many seconds inside one timed "
+                             "iteration (the --time auditor must TRIP — "
+                             "CI's proof knob, like --perturb-*)")
 
     def audit_flags(sp):
         sp.add_argument("--size", type=int, default=16)

@@ -11,6 +11,10 @@ turns the accumulated history into
   its leg's trimean ± MAD tolerance band (per-leg thresholds
   configurable; direction-aware: a throughput leg trips LOW, a
   seconds leg trips HIGH); exits nonzero with a named-leg verdict;
+- ``drift``:  the calibration drift sentinel — the installed cost-model
+  calibration's per-phase predictions must sit inside the measured
+  attribution samples' trimean ± MAD band (``obs/attribution.judge_drift``,
+  the same band formula as ``gate``); exits nonzero naming the phase;
 - ``render``: a markdown dashboard for CI artifacts;
 - ``ingest``: map payload files into the ledger (``--legacy`` for the
   committed BENCH_r0*/MULTICHIP_r0* shapes; metrics JSONL and live
@@ -466,11 +470,85 @@ def main(argv: Optional[list] = None) -> int:
                          "abs_tol, direction, min_history}}; '*' sets "
                          "defaults")
 
+    sp = sub.add_parser(
+        "drift",
+        help="calibration drift sentinel: judge the installed "
+             "calibration's predictions against a run's measured "
+             "attribution samples (exit 1 naming the drifted phase)")
+    sp.add_argument("--metrics", required=True,
+                    help="metrics JSONL with plan.attrib.phase records "
+                         "(a --metrics-out file)")
+    sp.add_argument("--phase", action="append", default=[],
+                    help="phase(s) to judge (default: every attributed "
+                         "phase)")
+    sp.add_argument("--mad-k", type=float, default=3.0,
+                    help="band half-width in MADs of the measured "
+                         "samples (default 3 — the gate's band)")
+    sp.add_argument("--rel-tol", type=float, default=0.05,
+                    help="band half-width floor as a fraction of the "
+                         "measured trimean (default 0.05; raise for "
+                         "noisy CPU fabrics — but keep it < 1, or a "
+                         "prediction far BELOW the measured center can "
+                         "never trip)")
+    sp.add_argument("--abs-tol", type=float, default=0.0)
+
     sp = sub.add_parser("render", help="markdown dashboard for CI artifacts")
     common(sp)
     sp.add_argument("--out", default="", help="also write the dashboard here")
 
     args = p.parse_args(argv)
+
+    if args.cmd == "drift":
+        # ledger-free like ingest: the evidence is one run's metrics
+        # file; the band authority is obs/attribution.judge_drift — the
+        # same trimean±max(k·MAD, rtol·|center|, atol) formula
+        # evaluate_gate applies to ledger history
+        if not os.path.exists(args.metrics):
+            print(f"[perf] no such metrics file: {args.metrics}",
+                  file=sys.stderr)
+            return 2
+        from ..obs import telemetry
+        from ..obs.attribution import judge_drift, phases_from_records
+
+        records: List[dict] = []
+        with open(args.metrics) as f:
+            for i, line in enumerate(f, 1):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    print(f"[perf] {args.metrics}:{i}: unparseable JSON "
+                          f"({e})", file=sys.stderr)
+                    return 2
+                errs = telemetry.validate_record(rec)
+                if errs:
+                    print(f"[perf] {args.metrics}:{i}: {errs[0]}",
+                          file=sys.stderr)
+                    return 2
+                records.append(rec)
+        phases = phases_from_records(records)
+        if args.phase:
+            phases = {k: v for k, v in phases.items() if k in args.phase}
+        if not phases:
+            print("[perf] drift judged nothing (no plan.attrib.phase "
+                  "records match)", file=sys.stderr)
+            return 2
+        drifted: List[str] = []
+        for phase, g in sorted(phases.items()):
+            v = judge_drift(phase, g["predicted_s"], g["samples"],
+                            mad_k=args.mad_k, rel_tol=args.rel_tol,
+                            abs_tol=args.abs_tol)
+            status = "PASS" if v.ok else "FAIL"
+            print(f"DRIFT {status} [{g['method']}] {v.describe()} "
+                  f"calibration={g['provenance'] or 'modeled(default)'}")
+            if not v.ok:
+                drifted.append(phase)
+        if drifted:
+            print(f"[perf] CALIBRATION DRIFT: {', '.join(drifted)} — "
+                  "refit with `plan_tool calibrate`", file=sys.stderr)
+            return 1
+        return 0
 
     if args.cmd == "ingest":
         if args.label and len(args.paths) > 1:

@@ -11,10 +11,17 @@ The operator's window into the plan/ subsystem (the analogue of
                 rounds 7/10) so fresh deployments replay them without
                 re-benching;
 - ``autotune``  tune one config now (the CI plan gate's entry point) —
-                a DB hit performs zero probes and says so.
+                a DB hit performs zero probes and says so;
+- ``calibrate`` fit calibration constants from a run's attribution
+                records (``plan.attrib.phase``) and install the
+                ``fitted(n=…, r2=…)`` row in the DB — the
+                predict→measure→refit loop's refit step;
+- ``calibration`` show/diff the installed fitted rows vs the modeled
+                defaults.
 
-``show``/``explain``/``prune``/``seed`` are jax-free: they run without a
-backend (the cost model is pure geometry). Only ``autotune`` compiles.
+``show``/``explain``/``prune``/``seed``/``calibrate``/``calibration``
+are jax-free: they run without a backend (the cost model is pure
+geometry and the fit is pure stdlib). Only ``autotune`` compiles.
 
 Usage: python -m stencil_tpu.apps.plan_tool show --db plans.json
        python -m stencil_tpu.apps.plan_tool explain --db plans.json \
@@ -84,17 +91,26 @@ def cmd_explain(args) -> int:
     config = _config_from(args)
     print(f"config key: {config.key()}")
     entry = None
+    calibration = None
+    cal_note = "modeled(default)"
     if args.db:
         db = plandb.load_db(args.db)
         entry = plandb.lookup(db, config)
+        # price the ranking with the DB's installed calibration, exactly
+        # as an autotune run against this DB would (plan/autotune.py)
+        cal_row = plandb.lookup_calibration(db, args.platform)
+        if cal_row is not None:
+            calibration = cal_row["calibration"]
+            cal_note = str(cal_row.get("provenance", "fitted"))
     if entry is not None:
         print(f"DB entry: {PlanChoice.from_json(entry['choice']).label()} "
               f"(source {entry['source']}, measured_s "
               f"{entry.get('measured_s')})")
     else:
         print("DB entry: none (an --autotune run would probe)")
-    ranked = rank(config, enumerate_candidates(config))
-    print(f"static ranking ({len(ranked)} feasible candidates):")
+    ranked = rank(config, enumerate_candidates(config), calibration)
+    print(f"static ranking ({len(ranked)} feasible candidates; "
+          f"calibration: {cal_note}):")
     for cost, choice in ranked[: args.top]:
         extra = (f" dmas={cost.dmas}" if choice.method == "remote-dma"
                  else "")
@@ -329,6 +345,111 @@ def cmd_seed(args) -> int:
     return 0
 
 
+def cmd_calibrate(args) -> int:
+    """Fit a calibration row from attribution evidence and install it
+    in the plan DB (the predict→measure→refit loop's refit step).
+    Jax-free: the evidence is a metrics JSONL or the LEDGER, the fit is
+    pure stdlib, and the DB write is the same atomic-rename path every
+    other subcommand uses."""
+    from ..obs import telemetry
+    from ..plan import calibrate as cal
+    from ..plan.cost import DEFAULT_CALIBRATION
+
+    if bool(args.from_metrics) == bool(args.from_ledger):
+        raise SystemExit(
+            "calibrate needs exactly one evidence source: "
+            "--from-metrics METRICS.jsonl or --from-ledger LEDGER.jsonl")
+    if args.from_metrics:
+        with open(args.from_metrics) as f:
+            lines = f.readlines()
+        n_ok, errs = telemetry.validate_jsonl(lines)
+        if errs:
+            raise SystemExit(
+                f"{args.from_metrics}: {len(errs)} schema-invalid records "
+                f"(first: {errs[0]}) — refusing to fit from a corrupt "
+                "metrics file")
+        records = [json.loads(ln) for ln in lines if ln.strip()]
+        samples = cal.samples_from_records(records)
+        src = args.from_metrics
+    else:
+        from ..obs.ledger import load_ledger
+
+        samples = cal.samples_from_ledger(load_ledger(args.from_ledger))
+        src = args.from_ledger
+    if getattr(args, "phase", None):
+        # one phase = one measurement population: probe chunks and the
+        # epilogue loop amortize dispatch overhead differently, and a
+        # fit across both prices neither correctly
+        want = set(args.phase)
+        samples = [s for s in samples if s.phase in want]
+        if not samples:
+            raise SystemExit(
+                f"no attribution samples match --phase "
+                f"{sorted(want)} in {src}")
+    try:
+        row = cal.fit(samples, platform=args.platform)
+    except cal.CalibrationError as e:
+        raise SystemExit(f"calibration fit refused: {e}")
+    db = plandb.load_db(args.db)
+    plandb.record_calibration(db, args.platform, row)
+    plandb.save_db(args.db, db)
+    print(f"fitted {args.platform} calibration from {len(samples)} "
+          f"samples ({src}) -> {args.db}")
+    print(f"provenance: {row['provenance']}"
+          + ("" if row["bandwidth_fit"]
+             else "  [bandwidth pinned at the modeled default: the "
+                  "samples share one (collectives, bytes) point]"))
+    for name, fitted, base_v in cal.diff_rows(row, DEFAULT_CALIBRATION):
+        print(f"  {name:45s} {fitted:.6e}  (modeled {base_v:.6e}, "
+              f"{fitted / base_v:.2f}x)")
+    if getattr(args, "metrics_out", ""):
+        rec = telemetry.configure(metrics_out=args.metrics_out,
+                                  app="plan_tool",
+                                  run_id=getattr(args, "run_id", "") or None,
+                                  config=vars(args))
+        rec.meta("calibration.fitted", platform=args.platform,
+                 n=int(row["n"]), provenance=row["provenance"],
+                 r2=float(row["r2"]))
+        rec.close()
+    return 0
+
+
+def cmd_calibration(args) -> int:
+    """``calibration show``: the DB's fitted rows. ``calibration diff``:
+    fitted constants vs the modeled defaults, one line per constant."""
+    from ..plan import calibrate as cal
+    from ..plan.cost import DEFAULT_CALIBRATION
+
+    db = plandb.load_db(args.db)
+    cals = db.get("calibrations") or {}
+    if args.action == "show":
+        if not cals:
+            print("no fitted calibrations (modeled defaults apply)")
+            return 0
+        print("platform,provenance,n,r2,bandwidth_fit")
+        for platform in sorted(cals):
+            row = cals[platform]
+            print(f"{platform},{row['provenance']},{row['n']},"
+                  f"{row['r2']:.4f},{row.get('bandwidth_fit', False)}")
+        return 0
+    # diff
+    platforms = [args.platform] if args.platform else sorted(cals)
+    if not platforms:
+        print("no fitted calibrations to diff (modeled defaults apply)")
+        return 0
+    for platform in platforms:
+        row = cals.get(platform)
+        if row is None:
+            print(f"{platform}: no fitted row (modeled defaults apply)")
+            continue
+        print(f"{platform} ({row['provenance']}):")
+        print("  constant,fitted,modeled,ratio")
+        for name, fitted, base_v in cal.diff_rows(row, DEFAULT_CALIBRATION):
+            print(f"  {name},{fitted:.6e},{base_v:.6e},"
+                  f"{fitted / base_v:.3f}")
+    return 0
+
+
 def cmd_autotune(args) -> int:
     import jax
 
@@ -453,6 +574,39 @@ def main(argv: Optional[list] = None) -> int:
     sp.add_argument("--force", action="store_true",
                     help="overwrite existing entries at the seed keys")
 
+    sp = sub.add_parser(
+        "calibrate",
+        help="fit calibration constants from attribution records and "
+             "install them in the DB (jax-free)")
+    sp.add_argument("--db", required=True)
+    sp.add_argument("--from-metrics", default="",
+                    help="metrics JSONL with plan.attrib.phase records "
+                         "(a --metrics-out file)")
+    sp.add_argument("--from-ledger", default="",
+                    help="LEDGER.jsonl with ingested plan.attrib.* "
+                         "entries (lower resolution: one trimean per "
+                         "run/phase)")
+    sp.add_argument("--phase", action="append", default=None,
+                    help="fit only samples of this phase (repeatable). "
+                         "One phase = one measurement population: probe "
+                         "chunks and the epilogue exchange loop amortize "
+                         "dispatch overhead differently")
+    sp.add_argument("--platform", default="cpu",
+                    help="platform key the fitted row serves (autotune "
+                         "installs it for matching configs)")
+    sp.add_argument("--metrics-out", default="",
+                    help="also append a calibration.fitted telemetry "
+                         "record here")
+    sp.add_argument("--run-id", default="")
+
+    sp = sub.add_parser("calibration",
+                        help="show or diff the DB's fitted calibrations "
+                             "(jax-free)")
+    sp.add_argument("action", choices=("show", "diff"))
+    sp.add_argument("--db", required=True)
+    sp.add_argument("--platform", default="",
+                    help="restrict diff to one platform (default: all)")
+
     sp = sub.add_parser("autotune", help="tune one config now")
     sp.add_argument("--db", default="")
     sp.add_argument("--cpu", type=int, default=0)
@@ -490,6 +644,8 @@ def main(argv: Optional[list] = None) -> int:
         "explain": cmd_explain,
         "prune": cmd_prune,
         "seed": cmd_seed,
+        "calibrate": cmd_calibrate,
+        "calibration": cmd_calibration,
         "autotune": cmd_autotune,
     }[args.cmd](args)
 

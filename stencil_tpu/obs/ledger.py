@@ -421,6 +421,7 @@ def entries_from_metrics_records(records: Sequence[dict], *,
     gauges: Dict[str, List[float]] = {}
     span_s: Dict[str, List[float]] = {}
     units: Dict[str, str] = {}
+    attrib: Dict[Tuple[str, str], dict] = {}
     config: Optional[dict] = None
     run_id: Optional[str] = None
     newest_t = None
@@ -432,6 +433,20 @@ def entries_from_metrics_records(records: Sequence[dict], *,
         if r.get("kind") == "meta" and r.get("name") == "config" and \
                 isinstance(r.get("config"), dict) and config is None:
             config = r["config"]
+        if r.get("kind") == "meta" and r.get("name") == "plan.attrib.phase":
+            # the observatory's calibration evidence: fold a run's
+            # samples to one trimean per (phase, method), carrying the
+            # (collectives, wire_bytes) point plan/calibrate's
+            # samples_from_ledger refits from
+            g = attrib.setdefault((str(r["phase"]), str(r["method"])), {
+                "samples": [], "collectives": int(r["collectives"]),
+                "wire_bytes": int(r["wire_bytes"]),
+                "predicted_s": float(r["predicted_s"]),
+                "provenance": str(r.get("provenance", "")),
+            })
+            v = float(r["measured_s"])
+            if math.isfinite(v):
+                g["samples"].append(v)
         tags = [str(r[k]) for k in ("method", "batched") if k in r]
         key = r["name"] + (f"[{','.join(tags)}]" if tags else "")
         # a NaN sample from a degenerate run must be dropped HERE: NaN
@@ -467,4 +482,20 @@ def entries_from_metrics_records(records: Sequence[dict], *,
                               platform=platform, config=config, rev=rev,
                               source="metrics", run=run_id, t=when,
                               detail={"samples": len(vals)}))
+    for (phase, method), g in sorted(attrib.items()):
+        if not g["samples"]:
+            continue
+        tm = trimean(g["samples"])
+        if not math.isfinite(tm):
+            continue
+        out.append(make_entry(
+            f"plan.attrib.{phase}", tm, label=f"{label}[{method}]",
+            unit="s", platform=platform, config=config, rev=rev,
+            source="metrics", run=run_id, t=when,
+            detail={"phase": phase, "method": method,
+                    "collectives": g["collectives"],
+                    "wire_bytes": g["wire_bytes"],
+                    "predicted_s": g["predicted_s"],
+                    "provenance": g["provenance"],
+                    "samples": len(g["samples"])}))
     return out

@@ -121,6 +121,31 @@ NAME_FIELDS = {
     "analysis.jit_audit": (("ok", int), ("recompiles", int),
                            ("transfers", int)),
     "analysis.lint": (("findings", int), ("new", int)),
+    # the plan-observatory vocabulary (obs/attribution.py +
+    # plan/calibrate.py): per-exchange-phase measured seconds mapped
+    # back onto the ExchangePlan IR's prediction under the installed
+    # calibration — the samples plan_tool calibrate fits and perf_tool
+    # drift judges. `phase` is the trace_range name of the measured
+    # region (so xprof device attribution keys the same way);
+    # `collectives` carries the plan's collective count for the permute
+    # methods and its DMA count for remote-dma (the per-copy overhead
+    # is what the fit recovers there).
+    "plan.attrib.phase": (("phase", str), ("method", str),
+                          ("predicted_s", float), ("measured_s", float),
+                          ("residual", float), ("collectives", int),
+                          ("wire_bytes", int)),
+    # the active plan + calibration stamp every instrumented run carries
+    # (jacobi3d/bench/_bench_common): LEDGER entries become attributable
+    # to the plan and calibration provenance that produced them
+    "plan.fingerprint": (("fingerprint", str), ("choice", str),
+                         ("calibration", str)),
+    # a calibrate run's fitted-row summary (plan_tool calibrate)
+    "calibration.fitted": (("platform", str), ("n", int),
+                           ("provenance", str)),
+    # the drift sentinel's in-run verdict: the installed calibration's
+    # prediction fell outside the measured phase's trimean±MAD band
+    "calibration.drift": (("phase", str), ("predicted_s", float),
+                          ("measured_s", float)),
 }
 
 # The sanctioned metric-name vocabulary: every LITERAL name the library
@@ -160,7 +185,7 @@ KNOWN_NAMES = frozenset(NAME_FIELDS) | frozenset({
     "jacobi.warmup",
     "live.anomaly_count",
     "machine", "machine.bandwidth_matrix", "machine.device",
-    "machine.distance_matrix", "machine.partition",
+    "machine.distance_matrix", "machine.fabric", "machine.partition",
     "overlap.hidden_frac",
     "pingpong.gb_per_s", "pingpong.latency_us",
     "plan.autotune", "plan.cache_hit", "plan.candidates", "plan.chosen",

@@ -51,12 +51,20 @@ MARKER_NAMES = frozenset({
     "anomaly.cleared",
     "slo.violation",
     "replan.requested",
+    # the drift sentinel's alarm (obs/attribution.emit_drift): the
+    # installed calibration's prediction fell out of the measured band
+    "calibration.drift",
 })
 
 _LANE_TAGS = ("app", "phase", "method", "batched", "iters", "step",
               "fault_kind", "quantity", "from_step", "to_step", "reason",
               "seconds", "value", "bytes", "seq", "unit",
-              "metric", "tenant", "deadline_ms", "p99_ms", "lane")
+              "metric", "tenant", "deadline_ms", "p99_ms", "lane",
+              # the attribution/drift vocabulary (obs/attribution.py):
+              # the marker args must carry the evidence the alarm is about
+              "predicted_s", "measured_s", "residual", "collectives",
+              "wire_bytes", "provenance", "band_lo", "band_hi",
+              "kernel_variant")
 
 
 def _args(rec: dict) -> dict:
@@ -129,6 +137,18 @@ def to_trace(records: Sequence[dict]) -> dict:
                 "ts": us(r["t"]), "pid": pid, "tid": tid,
                 "args": {"value": r.get("seq", 0)},
             })
+        elif kind == "meta" and name == "plan.attrib.phase":
+            # predicted-vs-measured as PAIRED counter tracks per phase:
+            # two flat-vs-jittering lines whose gap IS the calibration
+            # residual, readable at a glance next to the span lanes
+            for fld in ("predicted_s", "measured_s"):
+                if isinstance(r.get(fld), (int, float)):
+                    events.append({
+                        "ph": "C", "name": f"plan.attrib.{r['phase']}.{fld}",
+                        "cat": r.get("phase", "attrib"),
+                        "ts": us(r["t"]), "pid": pid, "tid": tid,
+                        "args": {"value": r[fld]},
+                    })
         if name in MARKER_NAMES:
             # the marker lands at the record's emission time (a span's END
             # — for ckpt.save that is the moment the snapshot was durable)

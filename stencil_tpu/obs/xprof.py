@@ -1,0 +1,120 @@
+"""Attribute DEVICE time to the plan's named ranges from an xprof dump.
+
+Host-side spans (``obs/attribution.py``) time the dispatch side of an
+exchange; on a real TPU the interesting seconds are on the device, and
+``jax.profiler``'s programmatic capture already records them — tagged
+with the very ``trace_range`` names the host spans use, because
+``utils/timer.trace_range`` wraps ``jax.profiler.TraceAnnotation``.
+This module turns one capture directory into per-range device seconds
+keyed by those names, so a TPU session's attribution records carry
+measured DEVICE time through the same ``plan.attrib.phase`` vocabulary
+(ROADMAP #1: the scarce hardware session auto-refits its calibration).
+
+Parsing is pure stdlib (gzip + json) over the Chrome-trace JSON the
+profiler writes under ``<logdir>/plugins/profile/<run>/``
+(``*.trace.json`` / ``*.trace.json.gz``): sum complete-event ("X")
+durations per event name, with the ``#…#`` argument suffix XLA appends
+stripped so "stencil.exchange#fused=…#" folds into "stencil.exchange".
+The TensorFlow-side protobuf tooling is deliberately NOT a dependency —
+a capture must be readable on the backend-less analysis box that runs
+``plan_tool calibrate``.
+
+``capture()`` is the collection side: a contextmanager around
+``jax.profiler.start_trace/stop_trace`` that degrades to a no-op when
+the profiler is unavailable or the platform is not TPU (CPU captures
+cost seconds and attribute nothing the host spans don't already have).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+from typing import Dict, Iterator, Optional, Sequence
+
+TRACE_GLOBS = ("*.trace.json.gz", "*.trace.json")
+
+
+def _iter_trace_files(logdir: str) -> Iterator[str]:
+    # the profiler nests runs under plugins/profile/<timestamp>/; accept
+    # a bare directory of dumps too so tests can synthesize one
+    roots = [logdir, os.path.join(logdir, "plugins", "profile")]
+    seen = set()
+    for root in roots:
+        for pat in TRACE_GLOBS:
+            for path in sorted(glob.glob(os.path.join(root, pat)) +
+                               glob.glob(os.path.join(root, "*", pat))):
+                if path not in seen:
+                    seen.add(path)
+                    yield path
+
+
+def _load_trace(path: str) -> dict:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt", encoding="utf-8") as f:
+            return json.load(f)
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _base_name(name: str) -> str:
+    # XLA suffixes annotations with #key=value# arg blocks; fold them
+    i = name.find("#")
+    return name[:i] if i > 0 else name
+
+
+def range_seconds(logdir: str,
+                  names: Optional[Sequence[str]] = None
+                  ) -> Dict[str, float]:
+    """Total device seconds per named range across every trace dump
+    under ``logdir``. ``names`` filters to the ranges of interest
+    (None = all). Durations are Chrome-trace microseconds."""
+    want = set(names) if names is not None else None
+    totals: Dict[str, float] = {}
+    for path in _iter_trace_files(logdir):
+        try:
+            doc = _load_trace(path)
+        except (OSError, ValueError):
+            continue  # a truncated dump attributes nothing
+        events = doc.get("traceEvents") or []
+        for ev in events:
+            if not isinstance(ev, dict) or ev.get("ph") != "X":
+                continue
+            name = _base_name(str(ev.get("name", "")))
+            if not name or (want is not None and name not in want):
+                continue
+            dur = ev.get("dur")
+            if isinstance(dur, (int, float)) and dur > 0:
+                totals[name] = totals.get(name, 0.0) + dur / 1e6
+    return totals
+
+
+@contextlib.contextmanager
+def capture(logdir: Optional[str]):
+    """Programmatic profiler capture, gated: yields True when a trace
+    is actually being recorded (TPU with a working profiler), False
+    otherwise — callers decide whether to parse ``logdir`` after.
+
+    Never raises out of the gate: a broken profiler must not take the
+    run it was meant to observe down with it."""
+    if not logdir:
+        yield False
+        return
+    try:
+        import jax
+        if jax.default_backend() != "tpu":
+            yield False
+            return
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        yield False
+        return
+    try:
+        yield True
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass

@@ -40,6 +40,11 @@ class AutotuneResult:
     entry: Optional[dict] = None
     ranked: List[Tuple[object, PlanChoice]] = field(default_factory=list)
     probes: List[dict] = field(default_factory=list)
+    # what priced the ranking: the override dict (None = defaults) and
+    # its provenance string — stamped into plan.chosen and the run's
+    # plan.fingerprint meta so ledger entries say which constants ranked
+    calibration: Optional[dict] = None
+    calibration_provenance: str = "modeled(default)"
 
 
 def default_choice(config: PlanConfig) -> PlanChoice:
@@ -136,6 +141,18 @@ def autotune(
         except plandb.PlanDBError as e:
             log.warn(f"plan DB {db_path} rejected ({e}); tuning without "
                      "persistence — fix or remove the file")
+    # the observatory loop's install half: a fitted calibration row in
+    # the DB (plan_tool calibrate) prices this platform's rankings until
+    # the caller overrides it explicitly
+    cal_provenance = ("modeled(default)" if calibration is None
+                      else str(calibration.get("provenance", "override")))
+    if calibration is None and db is not None:
+        cal_row = plandb.lookup_calibration(db, platform)
+        if cal_row is not None:
+            calibration = cal_row["calibration"]
+            cal_provenance = str(cal_row.get("provenance", "fitted"))
+            log.info(f"plan calibration: {cal_provenance} "
+                     f"(from {db_path})")
     if db is not None and not force:
         entry = plandb.lookup(db, config)
         if entry is not None:
@@ -143,12 +160,15 @@ def autotune(
             rec.gauge("plan.cache_hit", 1, phase="plan")
             rec.counter("plan.probes_run", value=0, phase="plan")
             rec.meta("plan.chosen", choice=entry["choice"], source="db",
-                     db_source=entry.get("source"), key=config.key())
+                     db_source=entry.get("source"), key=config.key(),
+                     calibration=cal_provenance)
             log.info(f"plan DB hit: {choice.label()} "
                      f"(tuned by {entry.get('source')}) — zero probes")
             return AutotuneResult(
                 config=config, choice=choice, source="db", cache_hit=True,
                 probes_run=0, candidates=0, entry=entry,
+                calibration=calibration,
+                calibration_provenance=cal_provenance,
             )
 
     with rec.span("plan.autotune", phase="plan"):
@@ -186,7 +206,7 @@ def autotune(
         static_cost = next(
             (c.total_s for c, ch in ranked if ch == choice), None)
         rec.meta("plan.chosen", choice=choice.to_json(), source=source,
-                 key=config.key())
+                 key=config.key(), calibration=cal_provenance)
         log.info(f"plan autotuned: {choice.label()} via {source} "
                  f"({n_probes} probes over {len(ranked)} candidates)")
 
@@ -199,5 +219,6 @@ def autotune(
     return AutotuneResult(
         config=config, choice=choice, source=source, cache_hit=False,
         probes_run=n_probes, candidates=len(ranked), entry=entry,
-        ranked=ranked, probes=probes,
+        ranked=ranked, probes=probes, calibration=calibration,
+        calibration_provenance=cal_provenance,
     )

@@ -18,7 +18,10 @@ Format: one JSON file, schema v1, validated like the metrics JSONL
         "measured_s":    float | null,     # per-exchange trimean (probe/seed)
         "probes":   [{"label": ..., "trimean_s": ...}, ...],
         "written_t": float,
-        "note":     str | null}}}
+        "note":     str | null}},
+     "calibrations": {"<platform>": {        # optional; absent = modeled
+        "calibration": {...score() override...},
+        "provenance": "fitted(n=…, r2=…)", "n": int, "r2": float, ...}}}
 
 Discipline mirrors ckpt/snapshot.py: writes are tmp + fsync + atomic
 rename (a crash never leaves a torn DB), corrupt or future-versioned
@@ -129,6 +132,27 @@ def validate_entry(key: str, entry) -> List[str]:
     return errs
 
 
+def validate_calibration_row(platform: str, row) -> List[str]:
+    """Violations of one fitted-calibration row (``calibrations``
+    section). The row is what :func:`stencil_tpu.plan.calibrate.fit`
+    returns: the score() override dict plus its fit provenance."""
+    pfx = f"calibration {platform!r}"
+    if not isinstance(row, dict):
+        return [f"{pfx} is not an object"]
+    errs: List[str] = []
+    if not isinstance(row.get("calibration"), dict):
+        errs.append(f"{pfx}: missing calibration override dict")
+    if not isinstance(row.get("provenance"), str) or not row.get("provenance"):
+        errs.append(f"{pfx}: provenance must be a non-empty string")
+    n = row.get("n")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 2:
+        errs.append(f"{pfx}: n must be an int >= 2 (a fit from fewer "
+                    "samples is refused at fit time, never persisted)")
+    if not isinstance(row.get("r2"), (int, float)):
+        errs.append(f"{pfx}: r2 must be numeric")
+    return errs
+
+
 def validate_db(obj) -> List[str]:
     """Schema violations of a parsed DB (empty = valid v1)."""
     if not isinstance(obj, dict):
@@ -144,6 +168,17 @@ def validate_db(obj) -> List[str]:
         return errs
     for key, entry in entries.items():
         errs.extend(validate_entry(key, entry))
+    # "calibrations" rides schema v1 the way placement rides entries: an
+    # ABSENT section is "no fitted rows, DEFAULT_CALIBRATION applies"
+    # (every pre-observatory DB loads unchanged); a present one maps
+    # platform -> fitted row
+    if "calibrations" in obj:
+        cals = obj["calibrations"]
+        if not isinstance(cals, dict):
+            errs.append("calibrations must be an object")
+        else:
+            for platform, row in cals.items():
+                errs.extend(validate_calibration_row(platform, row))
     return errs
 
 
@@ -233,6 +268,21 @@ def record(db: dict, entry: dict) -> dict:
     key = PlanConfig.from_json(entry["config"]).key()
     db["entries"][key] = entry
     return entry
+
+
+def record_calibration(db: dict, platform: str, row: dict) -> dict:
+    """Install/replace the fitted calibration row for ``platform``."""
+    errs = validate_calibration_row(platform, row)
+    if errs:
+        raise PlanDBError(f"refusing to record calibration: {errs[0]}")
+    db.setdefault("calibrations", {})[platform] = row
+    return row
+
+
+def lookup_calibration(db: dict, platform: str) -> Optional[dict]:
+    """The fitted calibration row for ``platform``, or None (the
+    absent-section default: DEFAULT_CALIBRATION, provenance modeled)."""
+    return (db.get("calibrations") or {}).get(platform)
 
 
 def prune_db(db: dict, platform: Optional[str] = None,

@@ -25,6 +25,7 @@ fixtures in tests/test_plan_ir.py and tests/test_exchange*.py.
 
 from __future__ import annotations
 
+import hashlib
 import json
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence, Tuple
@@ -1173,6 +1174,16 @@ class PlanChoice:
     def is_hierarchical(self) -> bool:
         """True when the choice carries a real (multi-host) outer split."""
         return self.hierarchy is not None and self.hierarchy[1] > 1
+
+    def fingerprint(self) -> str:
+        """Short stable content hash of the choice (12 hex chars of the
+        sha256 of its canonical JSON). The observatory's join key: a
+        telemetry/ledger/bench record stamped with it is attributable to
+        exactly this plan, where ``label()`` elides identity placements
+        and default fields for readability."""
+        blob = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
     def label(self) -> str:
         px, py, pz = self.partition

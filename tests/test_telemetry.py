@@ -193,8 +193,8 @@ def test_machine_info_json_records():
     rec = telemetry.Recorder(sink=buf, app="machine_info")
     out = machine_info.emit_records(r, rec)
     recs = _records(buf)
-    # machine + 8 devices + partition + 2 matrices
-    assert len(recs) == len(out) == 1 + 8 + 1 + 2
+    # machine + 8 devices + fabric fingerprint + partition + 2 matrices
+    assert len(recs) == len(out) == 1 + 8 + 1 + 1 + 2
     for rr in recs:
         assert telemetry.validate_record(rr) == [], rr
     devs = [rr for rr in recs if rr["name"] == "machine.device"]
@@ -202,6 +202,9 @@ def test_machine_info_json_records():
     assert all(rr["platform"] == "cpu" for rr in devs)
     m = next(rr for rr in recs if rr["name"] == "machine")
     assert m["devices"] == 8
+    fab = next(rr for rr in recs if rr["name"] == "machine.fabric")
+    assert fab["devices"] == 8 and fab["platform"] == "cpu"
+    assert fab["processes"] >= 1 and fab["hosts"] >= 1
     dm = next(rr for rr in recs if rr["name"] == "machine.distance_matrix")
     assert len(dm["matrix"]) == 8 and len(dm["matrix"][0]) == 8
     part = next(rr for rr in recs if rr["name"] == "machine.partition")
