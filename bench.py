@@ -502,6 +502,49 @@ def _child_main(mode: str, resume: bool = False) -> int:
         except Exception as e:
             errors["campaign"] = f"{type(e).__name__}: {e}"[:400]
 
+    # always-on serving leg (ISSUE 19): 16 pre-dropped jobs through the
+    # serve scheduler's B=8 continuous-batching slot — tracked as
+    # offered-load throughput (serve_tenants_per_hour) and the per-step
+    # p99 the admission controller prices deadlines from (serve_p99_ms)
+    serve_tph = 0.0
+    serve_p99_ms = None
+    if leg("always-on serve (16 jobs, continuous batching)"):
+        try:
+            import math as _math
+            import tempfile as _tf
+
+            from stencil_tpu.serve import ServeScheduler
+
+            sdir = _tf.mkdtemp(prefix="bench-serve-")
+            incoming = os.path.join(sdir, "jobs", "incoming")
+            os.makedirs(incoming, exist_ok=True)
+            serve_n, serve_jobs = 16, 16
+            for i in range(serve_jobs):
+                doc = {
+                    "job": f"b-{i:04d}", "size": serve_n, "steps": 4,
+                    "dtype": "float32", "workload": "jacobi", "seed": i,
+                    "tenant": f"tenant-{i % 4}", "priority": "normal",
+                }
+                tmp = os.path.join(incoming, f".tmp-{i}")
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, os.path.join(incoming, f"{doc['job']}.json"))
+            ndevs = 8 if len(jax.devices()) >= 8 else 1
+            summ = ServeScheduler(
+                sdir, 8, devices=jax.devices()[:ndevs], chunk=2,
+                poll_s=0.05, max_idle_s=0.5).serve()
+            if summ["retired"] != serve_jobs:
+                raise RuntimeError(
+                    f"serve leg retired {summ['retired']}/{serve_jobs}")
+            serve_tph = summ["tenants_per_hour"]
+            p99 = summ.get("p99_step_s")
+            if p99 is not None and _math.isfinite(p99):
+                serve_p99_ms = p99 * 1e3
+        except Exception as e:
+            errors["serve"] = f"{type(e).__name__}: {e}"[:400]
+
     # astaroth flagship details (BASELINE configs 4/4b): 8 fp32 fields,
     # fused Pallas RK3 substeps; skipped off-accelerator, via
     # STENCIL_BENCH_FAST=1, or when over budget (the three sliding-window
@@ -650,6 +693,13 @@ def _child_main(mode: str, resume: bool = False) -> int:
         ),
         "campaign_p99_step_s": (
             round(camp_p99, 6) if camp_p99 is not None else None
+        ),
+        # serving leg: offered-load throughput through the daemon's
+        # continuous-batching scheduler and the per-step p99 (ms) its
+        # admission controller prices deadlines from
+        "serve_tenants_per_hour": round(serve_tph, 1),
+        "serve_p99_ms": (
+            round(serve_p99_ms, 3) if serve_p99_ms is not None else None
         ),
         "astaroth_256_iter_ms": asta_ms,
         "astaroth_512_iter_ms": asta512_ms,

@@ -1,0 +1,405 @@
+#!/usr/bin/env python
+"""CI serving gate: continuous batching, drain, revival, SLO replan.
+
+The executable acceptance proof of ISSUE 19 (stencil_tpu/serve/ — the
+always-on campaign serving daemon) on the 8-virtual-device CPU mesh,
+no TPU needed:
+
+1. **continuous batching**: 8 pre-dropped jobs overflow a ``--slot 4``
+   daemon; the gate polls the atomic status snapshot, and the moment a
+   slot is observed RUNNING it drops a 9th job into the live intake —
+   the final summary must show exactly ONE slot, every job retired,
+   and >= 5 backfills (jobs entered mid-slot, no slot-wide barrier);
+   the metric stream must show the late job's ``serve.admitted``
+   AFTER ``campaign.slot``, and a mid-run status poll must see the
+   queue's ``admitted`` count reach 9 while the slot is still going;
+2. **SIGTERM drain**: a daemon mid-slot on 3 long jobs receives
+   SIGTERM and must exit 0 with outcome ``drained``, every trajectory
+   parked mid-flight (``serve.parked`` with 0 < step < steps, zero
+   retirements), and a restarted daemon revives all 3 from
+   ``serve-state.json`` and finishes them — each job retires exactly
+   once across both runs;
+3. **kill -> revive bit-identical**: the daemon runs under the PR 3
+   watchdog (``obs/watchdog.supervise``) with the injected kill hook
+   (``STENCIL_SERVE_KILL_AFTER_RETIRE=2`` -> ``os._exit(17)``); the
+   watchdog classifies the death as a CRASH, the revival attempt
+   finishes the queue, no retired job is ever re-run, and EVERY
+   tenant's final snapshot is bit-identical to an uninterrupted
+   reference serve of the same seeded load (``ckpt_tool diff --data``);
+4. **SLO-pressure replan**: deadline-doomed jobs (no admission ledger,
+   so they are admitted and the pressure builds online) must emit
+   ``replan.requested`` with reason ``slo-pressure`` and hot-swap a
+   plan between slots (``replan.applied``, trigger ``slo-pressure``)
+   persisted into ``--plan-db``;
+5. every metrics file passes ``report --validate``.
+
+Exit 0 only if every stage holds. Run from the repo root:
+
+  python scripts/ci_serve_gate.py [--out-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+KILL_ENV = "STENCIL_SERVE_KILL_AFTER_RETIRE"
+
+# one compiled bucket for stage 1: the late drop must be backfillable
+# into the already-running slot's program
+SIZE = 14
+
+
+def run(cmd, expect_rc=0, name="", env=None):
+    print(f"[serve-gate] {name}: {' '.join(cmd)}", flush=True)
+    p = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       env=env)
+    if p.returncode != expect_rc:
+        print(p.stdout)
+        print(p.stderr, file=sys.stderr)
+        raise SystemExit(
+            f"[serve-gate] {name}: rc={p.returncode}, expected {expect_rc}")
+    return p
+
+
+def load_records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def by_name(records, name):
+    return [r for r in records if r["name"] == name]
+
+
+def summary_of(stdout_text, name):
+    """The daemon's one-line JSON summary (the last JSON line printed)."""
+    for line in reversed(stdout_text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"[serve-gate] {name}: no JSON summary in stdout")
+
+
+def loadgen(serve_dir, *, jobs, steps, seed, tenants=2, size=SIZE,
+            rate=0.0, prefix="j", deadline_ms=0.0):
+    cmd = [PY, os.path.join(REPO, "scripts", "serve_loadgen.py"),
+           "--serve-dir", serve_dir, "--jobs", str(jobs),
+           "--steps", str(steps), "--seed", str(seed),
+           "--tenants", str(tenants), "--size", str(size),
+           "--rate", str(rate), "--prefix", prefix]
+    if deadline_ms > 0:
+        cmd += ["--deadline-ms", str(deadline_ms)]
+    return run(cmd, name=f"loadgen-{prefix}{seed}")
+
+
+def serve_cmd(serve_dir, metrics, status, *, slot=4, max_idle_s=2.0,
+              extra=()):
+    return [PY, "-m", "stencil_tpu.apps.serve", "--serve-dir", serve_dir,
+            "--cpu", "8", "--slot", str(slot), "--chunk", "2",
+            "--poll-s", "0.05", "--max-idle-s", str(max_idle_s),
+            "--metrics-out", metrics, "--status-file", status,
+            *extra]
+
+
+def read_status(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None  # not written yet / mid-rename on exotic FS
+
+
+def newest_snapshot(serve_dir, tid):
+    d = os.path.join(serve_dir, "campaign", "tenants", tid)
+    steps = [s for s in os.listdir(d) if s.startswith("step-")]
+    if not steps:
+        raise SystemExit(f"[serve-gate] no snapshots under {d}")
+    return os.path.join(
+        d, max(steps, key=lambda s: int(s.split("-", 1)[1])))
+
+
+def retired_jobs(*metric_paths):
+    out = []
+    for path in metric_paths:
+        out.extend(r["job"] for r in by_name(load_records(path),
+                                             "serve.retired"))
+    return out
+
+
+def stage1_continuous_batching(work):
+    sdir = os.path.join(work, "s1")
+    m1 = os.path.join(work, "m1.jsonl")
+    st1 = os.path.join(work, "status1.json")
+    loadgen(sdir, jobs=8, steps=12, seed=7, tenants=3)
+    cmd = serve_cmd(sdir, m1, st1)
+    print(f"[serve-gate] daemon (polled): {' '.join(cmd)}", flush=True)
+    # child output goes to FILES, not pipes: the poll loop never drains
+    # a pipe, so a chatty child would fill the OS buffer and deadlock
+    # the gate (the round-4 bench.py lesson watchdog.supervise encodes)
+    out_path = os.path.join(work, "daemon1.out")
+    err_path = os.path.join(work, "daemon1.err")
+    polls, dropped_late, seen_nine = [], False, False
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=out_f, stderr=err_f,
+                                text=True)
+        while proc.poll() is None:
+            doc = read_status(st1)
+            if doc and doc.get("queue"):
+                q = doc["queue"]
+                polls.append({"step": doc.get("step"),
+                              "admitted": q.get("admitted"),
+                              "depth": q.get("depth")})
+                mid_run = not doc.get("outcome")
+                if (not dropped_late and mid_run
+                        and (doc.get("step") or 0) >= 2):
+                    # the slot is observably RUNNING: drop job 9 into
+                    # the live intake — it must be admitted and
+                    # backfilled into THIS slot, not a second one
+                    loadgen(sdir, jobs=1, steps=4, seed=1, tenants=1,
+                            prefix="late")
+                    dropped_late = True
+                if dropped_late and mid_run and q.get("admitted") == 9:
+                    seen_nine = True
+            time.sleep(0.05)
+        proc.wait()
+    if proc.returncode != 0:
+        with open(err_path) as f:
+            print(f.read()[-8000:], file=sys.stderr)
+        raise SystemExit(f"[serve-gate] daemon1 rc={proc.returncode}")
+    if not dropped_late:
+        raise SystemExit(
+            f"[serve-gate] the status snapshot never showed a running "
+            f"slot, so the late job was never dropped ({len(polls)} polls)")
+    if not seen_nine:
+        raise SystemExit(
+            "[serve-gate] no mid-run status poll observed the late job "
+            f"admitted (queue.admitted == 9): {polls[-6:]}")
+    with open(out_path) as f:
+        summary = summary_of(f.read(), "daemon1")
+    if summary.get("slots") != 1:
+        raise SystemExit(f"[serve-gate] 9 jobs through a B=4 slot must "
+                         f"run as ONE slot (continuous batching), got "
+                         f"slots={summary.get('slots')}")
+    if summary.get("retired") != 9 or summary.get("rejected"):
+        raise SystemExit(f"[serve-gate] want 9 retired / 0 rejected: "
+                         f"{summary}")
+    if summary.get("backfills", 0) < 5:
+        raise SystemExit(f"[serve-gate] 9 jobs minus 4 lanes means >= 5 "
+                         f"backfills, got {summary.get('backfills')}")
+    results = os.listdir(os.path.join(sdir, "results"))
+    if len(results) != 9:
+        raise SystemExit(f"[serve-gate] want 9 streamed results, got "
+                         f"{sorted(results)}")
+    recs = load_records(m1)
+    slot_idx = min(i for i, r in enumerate(recs)
+                   if r["name"] == "campaign.slot")
+    late_idx = [i for i, r in enumerate(recs)
+                if r["name"] == "serve.admitted"
+                and r["job"].startswith("late-")]
+    if not late_idx or late_idx[0] <= slot_idx:
+        raise SystemExit(
+            f"[serve-gate] the late job's serve.admitted must land AFTER "
+            f"campaign.slot (admitted mid-slot): slot at {slot_idx}, "
+            f"late at {late_idx}")
+    run([PY, "-m", "stencil_tpu.apps.report", m1, "--validate"],
+        name="validate-1")
+    print(f"[serve-gate] stage 1: 1 slot, {summary['backfills']} "
+          f"backfills, late job admitted mid-slot (status poll saw "
+          f"admitted=9 live; {len(polls)} polls)")
+
+
+def stage2_sigterm_drain(work):
+    sdir = os.path.join(work, "s2")
+    m2a = os.path.join(work, "m2a.jsonl")
+    m2b = os.path.join(work, "m2b.jsonl")
+    st2 = os.path.join(work, "status2.json")
+    steps = 16
+    loadgen(sdir, jobs=3, steps=steps, seed=5, tenants=3, size=12)
+    cmd = serve_cmd(sdir, m2a, st2, slot=4)
+    print(f"[serve-gate] daemon (SIGTERM pending): {' '.join(cmd)}",
+          flush=True)
+    out_path = os.path.join(work, "daemon2.out")
+    err_path = os.path.join(work, "daemon2.err")
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=out_f, stderr=err_f,
+                                text=True)
+        while proc.poll() is None:
+            doc = read_status(st2)
+            if doc and (doc.get("step") or 0) >= 2 and not doc.get("outcome"):
+                proc.send_signal(signal.SIGTERM)
+                break
+            time.sleep(0.05)
+        rc = proc.wait(timeout=120)
+    if rc != 0:
+        with open(err_path) as f:
+            print(f.read()[-8000:], file=sys.stderr)
+        raise SystemExit(f"[serve-gate] SIGTERM must drain to exit 0, "
+                         f"got rc={rc}")
+    with open(out_path) as f:
+        summary = summary_of(f.read(), "daemon2")
+    if summary.get("outcome") != "drained" or summary.get("retired") != 0:
+        raise SystemExit(f"[serve-gate] want outcome=drained with 0 "
+                         f"retired (parked mid-flight): {summary}")
+    if summary.get("queued_remaining") != 3:
+        raise SystemExit(f"[serve-gate] all 3 jobs must survive the drain "
+                         f"in the queue: {summary}")
+    recs = load_records(m2a)
+    parked = by_name(recs, "serve.parked")
+    if len(parked) != 3 or not all(0 < r["step"] < steps for r in parked):
+        raise SystemExit(f"[serve-gate] want 3 mid-flight parks "
+                         f"(0 < step < {steps}): "
+                         f"{[(r.get('job'), r.get('step')) for r in parked]}")
+    drains = by_name(recs, "serve.drain")
+    if not drains or drains[0].get("reason") != "sigterm":
+        raise SystemExit(f"[serve-gate] serve.drain must name sigterm: "
+                         f"{drains}")
+    if not os.path.exists(os.path.join(sdir, "serve-state.json")):
+        raise SystemExit("[serve-gate] drain left no serve-state.json")
+
+    g = run(serve_cmd(sdir, m2b, st2, slot=4), name="drain-revival")
+    summary = summary_of(g.stdout, "drain-revival")
+    if summary.get("revived") != 3 or summary.get("retired") != 3:
+        raise SystemExit(f"[serve-gate] the restart must revive and "
+                         f"finish all 3: {summary}")
+    jobs = retired_jobs(m2a, m2b)
+    if sorted(jobs) != sorted(set(jobs)) or len(set(jobs)) != 3:
+        raise SystemExit(f"[serve-gate] each job must retire exactly "
+                         f"once across drain+revival: {sorted(jobs)}")
+    for path, name in ((m2a, "validate-2a"), (m2b, "validate-2b")):
+        run([PY, "-m", "stencil_tpu.apps.report", path, "--validate"],
+            name=name)
+    print("[serve-gate] stage 2: SIGTERM drained (3 mid-flight parks), "
+          "restart revived and finished all 3, nobody re-ran")
+
+
+def stage3_kill_revive_bit_identical(work):
+    spec = importlib.util.spec_from_file_location(
+        "stencil_watchdog",
+        os.path.join(REPO, "stencil_tpu", "obs", "watchdog.py"))
+    watchdog = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = watchdog  # dataclass resolves __module__
+    spec.loader.exec_module(watchdog)
+
+    ref = os.path.join(work, "s3-ref")
+    killed = os.path.join(work, "s3-killed")
+    for d in (ref, killed):
+        loadgen(d, jobs=5, steps=6, seed=11, tenants=2, size=12)
+    m_ref = os.path.join(work, "m3ref.jsonl")
+    g = run(serve_cmd(ref, m_ref, os.path.join(work, "status3r.json")),
+            name="reference-serve")
+    if summary_of(g.stdout, "reference-serve").get("retired") != 5:
+        raise SystemExit("[serve-gate] reference serve must retire all 5")
+
+    m3a = os.path.join(work, "m3a.jsonl")
+    m3b = os.path.join(work, "m3b.jsonl")
+    st3 = os.path.join(work, "status3.json")
+    env = dict(os.environ)
+    env[KILL_ENV] = "2"
+    att = watchdog.supervise(
+        serve_cmd(killed, m3a, st3), timeout_s=300, env=env, cwd=REPO,
+        name="serve-killed")
+    if att.outcome != watchdog.CRASH or att.rc != 17:
+        raise SystemExit(f"[serve-gate] the kill hook must die as a "
+                         f"watchdog CRASH with rc 17: outcome="
+                         f"{att.outcome} rc={att.rc}")
+    att = watchdog.supervise(
+        serve_cmd(killed, m3b, st3), timeout_s=300, cwd=REPO,
+        name="serve-revived")
+    if att.outcome != watchdog.OK:
+        raise SystemExit(f"[serve-gate] revival attempt: outcome="
+                         f"{att.outcome} rc={att.rc}\n{att.stderr_tail}")
+    summary = summary_of(att.stdout, "serve-revived")
+    if summary.get("retired") != 3 or not summary.get("revived"):
+        raise SystemExit(f"[serve-gate] revival must pick up the 3 "
+                         f"unserved jobs (2 retired pre-kill): {summary}")
+    jobs = retired_jobs(m3a, m3b)
+    if sorted(jobs) != sorted(set(jobs)) or len(set(jobs)) != 5:
+        raise SystemExit(f"[serve-gate] kill+revival must retire each of "
+                         f"the 5 jobs exactly once: {sorted(jobs)}")
+    for tid in sorted(set(jobs)):
+        a = newest_snapshot(killed, tid)
+        b = newest_snapshot(ref, tid)
+        run([PY, "-m", "stencil_tpu.apps.ckpt_tool", "diff", a, b,
+             "--data"], name=f"diff-{tid}")
+    for path, name in ((m3a, "validate-3a"), (m3b, "validate-3b")):
+        run([PY, "-m", "stencil_tpu.apps.report", path, "--validate"],
+            name=name)
+    print("[serve-gate] stage 3: watchdog CRASH rc=17 at 2 retirements, "
+          "revival finished 3, all 5 finals bit-identical to the "
+          "uninterrupted reference")
+
+
+def stage4_slo_pressure_replan(work):
+    sdir = os.path.join(work, "s4")
+    m4 = os.path.join(work, "m4.jsonl")
+    plan_db = os.path.join(work, "plans4.json")
+    # no admission ledger: the doomed deadline cannot be priced at
+    # admission, so the jobs run and the ONLINE p99 builds the pressure
+    loadgen(sdir, jobs=4, steps=8, seed=3, tenants=2, size=12,
+            deadline_ms=0.001)
+    g = run(serve_cmd(sdir, m4, os.path.join(work, "status4.json"),
+                      extra=("--replan", "--plan-db", plan_db)),
+            name="slo-pressure-serve")
+    summary = summary_of(g.stdout, "slo-pressure-serve")
+    if summary.get("retired") != 4:
+        raise SystemExit(f"[serve-gate] a deadline breach is evidence, "
+                         f"not an eviction — all 4 must finish: {summary}")
+    recs = load_records(m4)
+    req = [r for r in by_name(recs, "replan.requested")
+           if r.get("reason") == "slo-pressure"]
+    if not req:
+        raise SystemExit("[serve-gate] no slo-pressure replan.requested")
+    app = [r for r in by_name(recs, "replan.applied")
+           if r.get("trigger") == "slo-pressure"]
+    if not app:
+        raise SystemExit(f"[serve-gate] the latched pressure must "
+                         f"hot-swap between slots (replan.applied): "
+                         f"{by_name(recs, 'replan.rejected')}")
+    if not os.path.exists(plan_db) or not os.path.getsize(plan_db):
+        raise SystemExit("[serve-gate] the re-tuned plan must persist "
+                         "into --plan-db")
+    run([PY, "-m", "stencil_tpu.apps.report", m4, "--validate"],
+        name="validate-4")
+    print(f"[serve-gate] stage 4: slo-pressure requested at step "
+          f"{req[0].get('step')}, plan {app[0].get('old')} -> "
+          f"{app[0].get('new')} persisted")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out-dir", default="",
+                   help="keep status/metrics artifacts here for CI upload "
+                        "(default: a temp dir, removed)")
+    args = p.parse_args()
+    work = tempfile.mkdtemp(prefix="serve-gate-")
+    try:
+        stage1_continuous_batching(work)
+        stage2_sigterm_drain(work)
+        stage3_kill_revive_bit_identical(work)
+        stage4_slo_pressure_replan(work)
+        if args.out_dir:
+            out = os.path.abspath(args.out_dir)
+            os.makedirs(out, exist_ok=True)
+            for name in os.listdir(work):
+                if name.endswith((".jsonl", ".json", ".out", ".err")):
+                    shutil.copy2(os.path.join(work, name),
+                                 os.path.join(out, name))
+            print(f"[serve-gate] artifacts: {out}")
+        print("[serve-gate] PASS")
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""serve_loadgen — seeded Poisson open-loop load for the serving daemon.
+
+The reference producer for the file-drop intake protocol
+(stencil_tpu/serve/intake.py): one JSON document per job, written
+ATOMICALLY (tmp file in the same directory, then rename — the daemon
+must never see a half-written job), dropped into
+``<serve-dir>/jobs/incoming/`` with exponential inter-arrival gaps
+(open loop: the generator never waits for the daemon, which is what
+makes the daemon's admission control the thing under test, not the
+producer's backpressure).
+
+Everything is seeded: job ids, owners, priorities, deadlines and the
+arrival gaps all come from one ``random.Random(seed)``, so a gate or
+bench leg replays the exact same offered load every run. ``--rate 0``
+drops the whole batch immediately (the pre-loaded-queue mode the bench
+leg uses).
+
+PURE STDLIB — load generation must not wait on a jax import.
+
+Usage: python scripts/serve_loadgen.py --serve-dir /srv/stencil \
+           --jobs 16 --rate 4 --seed 7 --tenants 3 --quota-stress
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+PRIORITIES = ("high", "normal", "low")
+
+
+def drop_job(incoming: str, doc: dict) -> str:
+    """Atomically drop one job document (the intake write contract)."""
+    name = f"{doc['job']}.json"
+    tmp = os.path.join(incoming, f".tmp-{name}-{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    dst = os.path.join(incoming, name)
+    os.replace(tmp, dst)
+    return dst
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="seeded Poisson open-loop job generator for the "
+                    "serving daemon")
+    p.add_argument("--serve-dir", required=True,
+                   help="the daemon's service root (jobs land in "
+                        "<serve-dir>/jobs/incoming/)")
+    p.add_argument("--jobs", type=int, default=8,
+                   help="number of jobs to drop")
+    p.add_argument("--rate", type=float, default=4.0,
+                   help="mean arrival rate in jobs/s (Poisson: "
+                        "exponential gaps); 0 = drop everything at once")
+    p.add_argument("--seed", type=int, default=0,
+                   help="seeds ids, owners, priorities AND arrival gaps "
+                        "— the same seed replays the same offered load")
+    p.add_argument("--tenants", type=int, default=2,
+                   help="owners drawn uniformly from tenant-0..N-1")
+    p.add_argument("--size", type=int, default=12,
+                   help="per-job cubic domain edge")
+    p.add_argument("--steps", type=int, default=4,
+                   help="steps per job")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64"])
+    p.add_argument("--workload", default="jacobi",
+                   choices=["jacobi", "astaroth"])
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="per-step p99 SLO stamped on every job "
+                        "(0 = no deadline)")
+    p.add_argument("--mixed-priority", action="store_true",
+                   help="draw priorities high/normal/low (seeded) instead "
+                        "of all-normal")
+    p.add_argument("--prefix", default="j",
+                   help="job id prefix (ids are <prefix>-<seed>-<i>; two "
+                        "generators with different seeds never collide)")
+    args = p.parse_args(argv)
+    if args.jobs < 1:
+        p.error(f"--jobs must be >= 1, got {args.jobs}")
+    if args.rate < 0:
+        p.error(f"--rate must be >= 0, got {args.rate}")
+
+    incoming = os.path.join(args.serve_dir, "jobs", "incoming")
+    os.makedirs(incoming, exist_ok=True)
+    rng = random.Random(args.seed)
+    t0 = time.perf_counter()
+    dropped = []
+    for i in range(args.jobs):
+        if args.rate > 0 and i > 0:
+            time.sleep(rng.expovariate(args.rate))
+        doc = {
+            "job": f"{args.prefix}-{args.seed}-{i:04d}",
+            "size": args.size,
+            "steps": args.steps,
+            "dtype": args.dtype,
+            "workload": args.workload,
+            "seed": rng.randrange(1 << 20),
+            "tenant": f"tenant-{rng.randrange(args.tenants)}",
+            "priority": (rng.choice(PRIORITIES) if args.mixed_priority
+                         else "normal"),
+        }
+        if args.deadline_ms > 0:
+            doc["deadline_ms"] = args.deadline_ms
+        path = drop_job(incoming, doc)
+        print(f"[loadgen] dropped {os.path.basename(path)} "
+              f"(tenant={doc['tenant']}, priority={doc['priority']})",
+              file=sys.stderr, flush=True)
+        dropped.append(doc["job"])
+    print(json.dumps({
+        "app": "serve_loadgen", "dropped": len(dropped), "seed": args.seed,
+        "rate_per_s": args.rate, "wall_s": round(time.perf_counter() - t0, 3),
+        "first": dropped[0], "last": dropped[-1],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

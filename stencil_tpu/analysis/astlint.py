@@ -68,6 +68,10 @@ PURE_STDLIB_FILES = (
     "obs/watchdog.py",
     "obs/ledger.py",
     "obs/status.py",
+    # the serving daemon's durable queue state: read by revival tooling
+    # and ops scripts that must never wait on a jax import
+    "serve/state.py",
+    "scripts/serve_loadgen.py",
 )
 # bench.py's PARENT is pure-stdlib at module level only: the child code
 # paths (same file, function scope) import jax after the re-exec.

@@ -367,6 +367,56 @@ class CampaignDriver:
         self._lane_lat: Dict[str, deque] = {}
         self._slo_violated: set = set()
 
+    # -- serving extension points (stencil_tpu/serve/) ------------------------
+    # The always-on scheduler (serve/scheduler.py) subclasses the driver
+    # and overrides these hooks; the batch campaign is the degenerate
+    # case (a queue fixed at launch, no intake, no parking). Every hook
+    # sits at a point the slot machinery already treats as safe: queue
+    # scans, chunk boundaries, result assignment, segment boundaries.
+
+    def _refresh_queue(self, queue) -> None:
+        """Grow ``queue`` IN PLACE from an external intake. Called before
+        every backfill scan and once per chunk — the point where
+        backfill stops being a drain-time convenience and becomes
+        steady-state continuous batching: a job admitted here lands in a
+        RUNNING slot's next freed lane, never behind a slot barrier."""
+
+    def _observe_chunk(self, bucket, per: float, done_now: int) -> None:
+        """Per-chunk serving observation (latency pricing, SLO pressure,
+        queue status staging). ``per`` is the chunk's per-step wall."""
+
+    def _publish(self, results: Dict[str, "TenantResult"],
+                 r: "TenantResult") -> None:
+        """The ONE place a tenant's terminal result lands — every retire
+        / evict / revived-complete path funnels through here so a
+        serving layer can stream results as they happen."""
+        results[r.tid] = r
+        self._on_result(r)
+
+    def _on_result(self, r: "TenantResult") -> None:
+        """A tenant result just published (serve streams it to disk)."""
+
+    def _on_backfill(self, job: "TenantJob", lane_idx: int,
+                     slot_step: int) -> None:
+        """A queued tenant just took over a freed lane mid-slot."""
+
+    def _segment_end(self, slot_step: int, end: int) -> int:
+        """Cap a guarded segment's end step (must return in
+        ``(slot_step, end]``). The batch campaign runs each segment to
+        the earliest lane event; serving caps it to one fused chunk so
+        a drain request parks at the next CHUNK boundary instead of
+        waiting out a whole tenant."""
+        return end
+
+    def _should_park(self) -> bool:
+        """True = stop the slot at the next segment boundary and park
+        every live lane as a revivable snapshot (graceful drain)."""
+        return False
+
+    def _on_park(self, job: "TenantJob", tenant_step: int) -> None:
+        """A live lane was parked at ``tenant_step`` (snapshot already
+        durable) — the serving layer re-queues it for a later daemon."""
+
     # -- per-tenant durable state ---------------------------------------------
     def tenant_dir(self, tid: str) -> str:
         return os.path.join(self.campaign_dir, "tenants", tid)
@@ -549,9 +599,9 @@ class CampaignDriver:
                 # revived past its target: report done, leave the lane to
                 # a later backfill pass
                 fins = interior(padded)
-                results[job.tid] = TenantResult(
+                self._publish(results, TenantResult(
                     job.tid, "done", job.steps, self.tenant_dir(job.tid),
-                    final=fins[names[0]], finals=fins)
+                    final=fins[names[0]], finals=fins))
                 continue
             lanes[i].tenant = job
             lanes[i].start_slot_step = 0
@@ -588,6 +638,7 @@ class CampaignDriver:
             """Replace a retired/evicted lane from the queue (same bucket
             only) or mark it dead (zeros). Takes and returns the whole
             quantity dict — every quantity's lane moves together."""
+            self._refresh_queue(queue)
             job = None
             for cand in list(queue):
                 if cand.bucket() == bucket:
@@ -604,15 +655,16 @@ class CampaignDriver:
             t0_step, padded = lane_init(job)
             if t0_step >= job.steps:
                 fins = interior(padded)
-                results[job.tid] = TenantResult(
+                self._publish(results, TenantResult(
                     job.tid, "done", job.steps, self.tenant_dir(job.tid),
-                    final=fins[names[0]], finals=fins)
+                    final=fins[names[0]], finals=fins))
                 return backfill(lane, slot_step, state)
             lane.tenant = job
             lane.start_slot_step = slot_step
             lane.start_tenant_step = t0_step
             rec.meta("campaign.backfill", tenant=job.tid, lane=lane.idx,
                      slot=slot_idx, slot_step=int(slot_step))
+            self._on_backfill(job, lane.idx, int(slot_step))
             return {
                 name: state[name].at[lane.idx].set(
                     jnp.asarray(padded[name]))
@@ -703,6 +755,12 @@ class CampaignDriver:
                 if l.tenant is not None:
                     self._lane_lat.setdefault(
                         l.tenant.tid, deque(maxlen=256)).append(per)
+            # steady-state serving: pull any newly-arrived jobs into the
+            # LIVE queue every chunk (so a retire later in this same
+            # slot backfills them — no slot-wide barrier), then let the
+            # serving layer observe the chunk (pricing, SLO pressure)
+            self._refresh_queue(queue)
+            self._observe_chunk(bucket, per, done_now)
             check_slo(done_now)
             if self.status is not None:
                 # stage only: run_guarded's per-chunk update (which runs
@@ -730,8 +788,26 @@ class CampaignDriver:
             return s, dict(st)
 
         while any(l.tenant is not None for l in lanes):
+            if self._should_park():
+                # graceful drain: every live lane's current state becomes
+                # a revivable snapshot (the eviction persistence path,
+                # minus the eviction) and the slot ends here — a later
+                # daemon resumes each tenant from exactly this step
+                host = {name: np.asarray(jax.device_get(curr[name]))
+                        for name in names}
+                for l in lanes:
+                    if l.tenant is None:
+                        continue
+                    tstep = l.tenant_step(slot_step)
+                    self._write_tenant_snapshot(
+                        l.tenant, spec,
+                        {name: host[name][l.idx] for name in names}, tstep)
+                    self._on_park(l.tenant, tstep)
+                    l.tenant = None
+                break
             end = min(l.end_slot_step() for l in lanes
                       if l.tenant is not None)
+            end = self._segment_end(slot_step, end)
             state = dict(curr)
             stash = (slot_step, dict(state))
 
@@ -781,9 +857,9 @@ class CampaignDriver:
                 self._write_tenant_snapshot(job, spec, lane_host,
                                             job.steps)
                 fins = interior(lane_host)
-                results[job.tid] = TenantResult(
+                self._publish(results, TenantResult(
                     job.tid, "done", job.steps, self.tenant_dir(job.tid),
-                    final=fins[names[0]], finals=fins)
+                    final=fins[names[0]], finals=fins))
                 rec.meta("campaign.retire", tenant=job.tid,
                          step=int(job.steps), lane=l.idx, slot=slot_idx)
                 curr = backfill(l, slot_step, curr)
@@ -820,8 +896,8 @@ class CampaignDriver:
         self._write_tenant_snapshot(
             job, spec, {name: host[name][lane.idx] for name in names},
             healthy_tstep)
-        results[job.tid] = TenantResult(
-            job.tid, "fault", healthy_tstep, tdir, evidence=evidence)
+        self._publish(results, TenantResult(
+            job.tid, "fault", healthy_tstep, tdir, evidence=evidence))
         rec.meta("campaign.evict", tenant=job.tid,
                  step=int(f.tenant_step), lane=lane.idx, slot=slot_idx,
                  rc=FAULT_RC, healthy_step=int(healthy_tstep),

@@ -25,7 +25,7 @@ Entry schema (v1) — one JSON object per line::
      "rev":      str | null,   # git revision of the measured tree
      "label":    str,          # round/run label, e.g. "r05"
      "source":   "bench" | "legacy-bench" | "legacy-multichip"
-               | "metrics" | "manual",
+               | "metrics" | "manual" | "serve",
      "t":        unix seconds,
      "run":      str | null,   # telemetry run id where applicable
      "detail":   object?}      # free-form provenance (config detail, tags)
@@ -62,7 +62,8 @@ except ImportError:  # pragma: no cover
 
 SCHEMA_VERSION = 1
 LEDGER_KIND = "perf-ledger"
-SOURCES = ("bench", "legacy-bench", "legacy-multichip", "metrics", "manual")
+SOURCES = ("bench", "legacy-bench", "legacy-multichip", "metrics", "manual",
+           "serve")
 _TMP_PREFIX = ".tmp-"
 
 # bench.py contract: the parent appends its payload here after each round.

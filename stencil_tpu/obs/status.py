@@ -28,7 +28,14 @@ Status document (schema v1)::
      "lanes": [{"lane": int, "tenant": str|null, "step": int?,
                 "steps": int?, "p50_ms": float?, "p99_ms": float?,
                 "deadline_ms": float?, "slo": "ok"|"violated"|null}]?,
-     "slo": {"violations": [tid, ...]}?}
+     "slo": {"violations": [tid, ...]}?,
+     "queue": {"depth": int, "admitted": int, "rejected": int,
+               "backfills": int, ...}?}
+
+The ``queue`` section is the serving daemon's (stencil_tpu/serve/):
+waiting depth plus cumulative admission counters, so ``report --status
+--follow`` reads as a serving dashboard. Additive — this function stays
+the single schema authority.
 
 PURE STDLIB by the watchdog/ledger contract: a supervisor (or a human's
 ``watch``) must be able to read the file without the package.
@@ -127,6 +134,15 @@ def validate_status(doc) -> List[str]:
     if s is not None and (not isinstance(s, dict)
                           or not isinstance(s.get("violations"), list)):
         errs.append("slo must be an object with a 'violations' list")
+    q = doc.get("queue")
+    if q is not None:
+        if not isinstance(q, dict):
+            errs.append("queue must be an object")
+        else:
+            for fld in ("depth", "admitted", "rejected", "backfills"):
+                v = q.get(fld)
+                if isinstance(v, bool) or not isinstance(v, int):
+                    errs.append(f"queue.{fld} must be an integer")
     return errs
 
 
@@ -214,6 +230,17 @@ def render_status(doc: dict, now: Optional[float] = None) -> str:
                      f"{a.get('cleared', 0)} cleared")
     if parts:
         lines.append(" · ".join(parts))
+    q = doc.get("queue")
+    if isinstance(q, dict):
+        qline = (f"queue: depth={q.get('depth', 0)} "
+                 f"admitted={q.get('admitted', 0)} "
+                 f"rejected={q.get('rejected', 0)} "
+                 f"backfills={q.get('backfills', 0)}")
+        if isinstance(q.get("deferred"), int):
+            qline += f" deferred={q['deferred']}"
+        if isinstance(q.get("retired"), int):
+            qline += f" retired={q['retired']}"
+        lines.append(qline)
     for ev in (a or {}).get("active") or []:
         lines.append(
             f"  ANOMALY {ev.get('metric')} since step {ev.get('step')}: "

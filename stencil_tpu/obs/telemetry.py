@@ -87,6 +87,17 @@ NAME_FIELDS = {
     "anomaly.cleared": (("metric", str), ("step", int)),
     "slo.violation": (("tenant", str), ("step", int)),
     "replan.requested": (("reason", str), ("step", int)),
+    # the always-on serving vocabulary (stencil_tpu/serve/): intake
+    # admission verdicts (admit / quota-defer / priced rejection),
+    # per-tenant result streaming, and the drain/park/revival
+    # provenance the serve CI gate greps for
+    "serve.admitted": (("job", str),),
+    "serve.rejected": (("job", str), ("reason", str)),
+    "serve.deferred": (("job", str), ("reason", str)),
+    "serve.retired": (("job", str), ("outcome", str)),
+    "serve.parked": (("job", str), ("step", int)),
+    "serve.drain": (("reason", str),),
+    "serve.revived": (("jobs", int),),
     # the hot-swap half of ROADMAP #6 (plan/replan.ReplanController):
     # a mid-run replan either installs a new compiled plan (applied —
     # old/new choice labels + the static model's predicted gain rides
@@ -195,6 +206,10 @@ KNOWN_NAMES = frozenset(NAME_FIELDS) | frozenset({
     # modeled identity-over-placed improvement ratio
     "qap.cost", "qap.improvement", "qap.placement_cost", "qap.solve_s",
     "recover.backoff_s",
+    # the serving daemon's exit gauges: sustained completion rate and
+    # per-step tail latency under open-loop arrivals (the ROADMAP #4
+    # bench leg), plus the queue-depth gauge the dashboard trends
+    "serve.p99_ms", "serve.queue_depth", "serve.tenants_per_hour",
     "wire_ab.bytes_ratio", "wire_ab.max_abs_err", "wire_ab.max_rel_err",
     "wire_ab.max_ulp_err",
 })

@@ -1,0 +1,20 @@
+"""Always-on campaign serving: intake, admission, continuous batching.
+
+The batch campaign (``stencil_tpu/campaign/``) answers "run this fixed
+job list to completion"; this package turns the same driver into a
+persistent daemon — jobs arrive as file drops while slots are running,
+admission control prices deadlines from the performance ledger, retired
+lanes are backfilled from a LIVE queue with no slot-wide barrier, and a
+killed daemon revives from ``serve-state.json`` owing exactly the jobs
+it had admitted but not retired. ``stencil-tpu serve`` (apps/serve.py)
+is the CLI front-end.
+"""
+
+from .admission import (AdmissionController, BucketPricer,  # noqa: F401
+                        LEDGER_METRIC, bucket_label)
+from .intake import (Intake, PRIORITIES, ServeJob,  # noqa: F401
+                     job_from_doc, validate_job)
+from .queue import ServeQueue, pick_serve_slot  # noqa: F401
+from .scheduler import ServeScheduler  # noqa: F401
+from .state import (JOB_STATES, LIVE_STATES, make_state,  # noqa: F401
+                    read_state, validate_state, write_state)

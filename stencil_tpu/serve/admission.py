@@ -1,0 +1,138 @@
+"""Admission control: quotas, priority classes, priced deadline rejection.
+
+Three verdicts, in judgment order:
+
+- **reject** — the job's per-step ``deadline_ms`` is infeasible against
+  the bucket's known p99 step latency. The pricing comes from the
+  :class:`BucketPricer`: ONLINE samples once the daemon has stepped the
+  bucket (the driver's per-chunk wall times), seeded from the
+  performance LEDGER's per-bucket entries before that (metric
+  ``serve.step_p99_ms``, ``detail.bucket`` keyed — the daemon writes
+  them back at drain, so pricing survives restarts). A rejection always
+  NAMES its price and source. No price -> no rejection: admission never
+  guesses.
+- **defer** — the owning tenant is at its quota of live (queued +
+  running) jobs. Quota exhaustion QUEUES, it never rejects: the job
+  waits in a holding pen and is promoted the moment one of the
+  tenant's jobs retires.
+- **admit** — into the LIVE priority queue.
+
+Priority classes reorder only QUEUED jobs (the queue's order key); a
+running lane is never preempted — structurally, because admission and
+the queue only ever see unscheduled jobs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import ledger as ledger_mod
+from ..utils.statistics import percentile
+from .intake import ServeJob
+
+# the ledger metric carrying a bucket's p99 per-step latency prior
+# (milliseconds); detail.bucket holds the bucket label
+LEDGER_METRIC = "serve.step_p99_ms"
+
+
+def bucket_label(bucket) -> str:
+    """``(size, dtype, workload) -> "16x16x16/float32/jacobi"`` — the
+    human- and ledger-facing bucket key."""
+    (size, dtype, workload) = bucket
+    x, y, z = size
+    return f"{x}x{y}x{z}/{dtype}/{workload}"
+
+
+class BucketPricer:
+    """Per-bucket p99 step latency: online samples first, ledger priors
+    until the daemon has its own evidence."""
+
+    def __init__(self, ledger_path: Optional[str] = None, *,
+                 window: int = 256, min_samples: int = 3):
+        self.ledger_path = ledger_path or None
+        self.window = int(window)
+        self.min_samples = max(1, int(min_samples))
+        self._online: Dict[str, deque] = {}
+        self._prior: Dict[str, Tuple[float, str, float]] = {}
+        if self.ledger_path:
+            # a corrupt ledger raises (LedgerError is a ValueError):
+            # silently pricing from nothing would admit infeasible work
+            for e in ledger_mod.load_ledger(self.ledger_path):
+                if e.get("metric") != LEDGER_METRIC:
+                    continue
+                b = (e.get("detail") or {}).get("bucket")
+                if not isinstance(b, str):
+                    continue
+                prev = self._prior.get(b)
+                if prev is None or e.get("t", 0) >= prev[2]:
+                    self._prior[b] = (
+                        float(e["value"]),
+                        f"ledger {self.ledger_path} [{e.get('label')}]",
+                        e.get("t", 0))
+
+    def observe(self, bucket, per_step_s: float) -> None:
+        """One chunk's per-step wall time for ``bucket`` (seconds)."""
+        self._online.setdefault(
+            bucket_label(bucket), deque(maxlen=self.window)).append(
+            float(per_step_s))
+
+    def price(self, bucket) -> Optional[Tuple[float, str]]:
+        """``(p99_ms, source)`` for the bucket, or None (unknown — the
+        daemon has never stepped the shape and the ledger is silent)."""
+        label = bucket_label(bucket)
+        samples = self._online.get(label)
+        if samples and len(samples) >= self.min_samples:
+            return (percentile(samples, 99) * 1e3,
+                    f"online p99 over {len(samples)} chunks")
+        prior = self._prior.get(label)
+        if prior is not None:
+            return (prior[0], prior[1])
+        return None
+
+    def ledger_entries(self, *, platform: str, label: str) -> List[dict]:
+        """One ledger entry per online-priced bucket — appended at drain
+        so the NEXT daemon prices admission before its first step."""
+        out = []
+        for b, samples in sorted(self._online.items()):
+            if len(samples) < self.min_samples:
+                continue
+            out.append(ledger_mod.make_entry(
+                LEDGER_METRIC, percentile(samples, 99) * 1e3,
+                label=label, unit="ms", platform=platform, source="serve",
+                config={"bucket": b}, detail={"bucket": b,
+                                              "samples": len(samples)}))
+        return out
+
+
+class AdmissionController:
+    """The verdict function. ``quota`` is the per-tenant cap on LIVE
+    (queued + running) jobs; 0 = unlimited."""
+
+    def __init__(self, *, quota: int = 0,
+                 pricer: Optional[BucketPricer] = None):
+        if quota < 0:
+            raise ValueError(f"quota must be >= 0, got {quota}")
+        self.quota = int(quota)
+        self.pricer = pricer
+
+    def decide(self, job: ServeJob,
+               live_by_owner: Dict[str, int]) -> Tuple[str, str]:
+        """``("admit" | "defer" | "reject", reason)``. Infeasibility is
+        judged before quota — a doomed job must not occupy a quota
+        slot waiting to be doomed."""
+        if job.deadline_ms is not None and self.pricer is not None:
+            priced = self.pricer.price(job.bucket())
+            if priced is not None:
+                p99_ms, source = priced
+                if float(job.deadline_ms) < p99_ms:
+                    return ("reject",
+                            f"deadline {job.deadline_ms:g} ms infeasible: "
+                            f"bucket {bucket_label(job.bucket())} p99 is "
+                            f"{p99_ms:.4g} ms ({source})")
+        if self.quota and live_by_owner.get(job.owner, 0) >= self.quota:
+            return ("defer",
+                    f"tenant {job.owner} at quota "
+                    f"({live_by_owner.get(job.owner, 0)}/{self.quota} "
+                    "live jobs); queued for promotion")
+        return ("admit", "")

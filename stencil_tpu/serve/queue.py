@@ -1,0 +1,68 @@
+"""The LIVE priority queue and the deadline-sorted slot packer.
+
+The batch driver's queue is a deque fixed at launch; this one grows
+while slots run. It keeps the driver's backfill protocol — iteration
+and ``remove`` — so ``CampaignDriver``'s backfill closure pulls from it
+unchanged, but its iteration ORDER is the serving policy: priority
+class, then deadline (tightest first), then admission order. Because
+the queue holds only unscheduled jobs, priority reordering can only
+ever affect QUEUED tenants — a running lane is structurally
+unpreemptable.
+
+:func:`pick_serve_slot` is :func:`~..campaign.driver.pick_slot`'s
+serving twin: the head (most urgent job) names the bucket, same-bucket
+jobs fill the slot in queue order — deadline-sorted bucket packing. It
+removes the picked jobs IN PLACE so the queue object stays live for
+mid-slot backfill.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+from .intake import ServeJob
+
+
+class ServeQueue:
+    """A small always-sorted job list (serving queues are tens of jobs;
+    sort-on-admit keeps every scan trivially in policy order)."""
+
+    def __init__(self):
+        self._items: List[ServeJob] = []
+
+    def admit(self, job: ServeJob) -> None:
+        self._items.append(job)
+        self._items.sort(key=ServeJob.order_key)
+
+    def remove(self, job: ServeJob) -> None:
+        self._items.remove(job)
+
+    def peek(self) -> ServeJob:
+        if not self._items:
+            raise RuntimeError("peek on an empty serve queue")
+        return self._items[0]
+
+    def jobs(self) -> List[ServeJob]:
+        return list(self._items)
+
+    def __iter__(self) -> Iterator[ServeJob]:
+        return iter(list(self._items))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+
+def pick_serve_slot(queue: ServeQueue,
+                    slot_size: int) -> Tuple[Tuple, List[ServeJob]]:
+    """Pop the next slot's jobs from the LIVE queue: the most urgent
+    job's bucket, same-bucket jobs pulled in queue order (priority,
+    deadline, arrival) until the slot fills. Returns ``(bucket,
+    picked)``; the queue keeps everything else."""
+    bucket = queue.peek().bucket()
+    picked = [j for j in queue if j.bucket() == bucket][:slot_size]
+    for j in picked:
+        queue.remove(j)
+    return bucket, picked

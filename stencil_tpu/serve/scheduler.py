@@ -1,0 +1,511 @@
+"""The always-on scheduler: continuous batching over the campaign driver.
+
+:class:`ServeScheduler` subclasses :class:`~..campaign.driver.
+CampaignDriver` and overrides its serving hooks — the batch campaign's
+machinery (bucketed slots, guarded segments, eviction, per-tenant
+snapshots) is reused verbatim; what changes is WHERE jobs come from and
+WHEN they may enter:
+
+- **Live intake.** ``_refresh_queue`` (called by the driver before every
+  backfill scan and once per chunk) claims ``jobs/incoming/`` drops,
+  runs admission, and grows the LIVE queue — so a job that arrives
+  while a slot is mid-flight lands in the very next freed lane, with no
+  slot-wide barrier. That is the continuous-batching extension: the
+  driver's backfill path, promoted from drain-time to steady-state.
+- **Deadline-sorted packing.** Slot selection is
+  :func:`~.queue.pick_serve_slot`: the most urgent queued job names the
+  bucket, same-bucket jobs fill the slot tightest-deadline-first.
+- **SLO pressure.** ``_observe_chunk`` prices every chunk into the
+  :class:`~.admission.BucketPricer`; when a queued or running job's
+  deadline falls under the bucket's online p99, the scheduler emits a
+  first-class ``replan.requested`` (reason ``slo-pressure``) and
+  latches the :class:`~..plan.replan.ReplanController` — the hot-swap
+  fires at the next slot boundary, exactly like a sentinel anomaly.
+- **Result streaming.** ``_on_result`` writes ``results/<job>.json``
+  atomically the moment a tenant retires (or faults out), emits
+  ``serve.retired``, and promotes deferred jobs into freed quota.
+- **Drain + revival.** ``request_drain`` (the SIGTERM handler's one
+  call) parks every live lane as a revivable snapshot at the next
+  segment boundary; ``serve-state.json`` (serve/state.py, atomic)
+  always knows which jobs are owed work, so a killed-and-revived
+  daemon resumes admitted-but-unserved jobs and never re-runs retired
+  ones — whole-service crash-revival rides the PR 3 watchdog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..campaign.driver import CampaignDriver, TenantResult
+from ..obs import ledger as ledger_mod
+from ..obs import telemetry
+from ..utils import logging as log
+from ..utils.statistics import percentile
+from . import state as state_mod
+from .admission import AdmissionController, BucketPricer, bucket_label
+from .intake import Intake, ServeJob, job_from_doc, validate_job
+from .queue import ServeQueue, pick_serve_slot
+
+
+class ServeScheduler(CampaignDriver):
+    """A persistent :class:`CampaignDriver` fed by file-drop intake.
+
+    ``serve_dir`` owns the whole service: ``jobs/`` (intake),
+    ``campaign/`` (slot machinery + tenant snapshots), ``results/``
+    (streamed per-tenant results), ``serve-state.json``. ``quota`` is
+    the per-tenant cap on live jobs (0 = unlimited);
+    ``admission_ledger`` seeds deadline pricing and receives the run's
+    per-bucket p99 back at exit; ``max_idle_s`` > 0 exits after that
+    long with an empty queue (0 = serve until drained by signal);
+    ``max_wall_s`` > 0 is a total-budget self-drain."""
+
+    def __init__(self, serve_dir: str, slot_size: int, *,
+                 quota: int = 0, admission_ledger: Optional[str] = None,
+                 poll_s: float = 0.2, max_idle_s: float = 0.0,
+                 max_wall_s: float = 0.0, **kw):
+        kw.setdefault("resume", True)  # revival is the serving default
+        super().__init__([], slot_size,
+                         os.path.join(serve_dir, "campaign"), **kw)
+        self.serve_dir = serve_dir
+        self.results_dir = os.path.join(serve_dir, "results")
+        self.state_path = os.path.join(serve_dir, "serve-state.json")
+        self.intake = Intake(serve_dir)
+        self.pricer = BucketPricer(admission_ledger)
+        self.admission = AdmissionController(quota=quota, pricer=self.pricer)
+        self.admission_ledger = admission_ledger or None
+        self.poll_s = max(0.01, float(poll_s))
+        self.max_idle_s = float(max_idle_s)
+        self.max_wall_s = float(max_wall_s)
+        self.queue = ServeQueue()
+        self.state = state_mod.make_state()
+        self.results: Dict[str, TenantResult] = {}
+        self._deferred: List[ServeJob] = []
+        self._jobs_by_id: Dict[str, ServeJob] = {}
+        self._running: set = set()
+        self._drain = False
+        self._drain_reason = ""
+        self._pressure_sent: set = set()
+        self._all_lat: List[float] = []
+        self._retired_run = 0
+        self._seq = 0
+        self._last_bucket: Optional[Tuple] = None
+
+    # -- drain (the SIGTERM handler calls exactly this) -----------------------
+    def request_drain(self, reason: str) -> None:
+        """Stop claiming intake, park live lanes at the next segment
+        boundary, persist everything, exit cleanly. Signal-safe: plain
+        assignments only — the serve loop does the work."""
+        self._drain = True
+        if not self._drain_reason:
+            self._drain_reason = str(reason)
+
+    # -- durable state --------------------------------------------------------
+    def _flush_state(self) -> None:
+        self.state["draining"] = self._drain
+        state_mod.write_state(self.state_path, self.state)
+
+    def _counters(self) -> dict:
+        return self.state["counters"]
+
+    def queue_stat(self) -> dict:
+        """The status snapshot's ``queue`` section (obs/status.py)."""
+        c = self._counters()
+        return {
+            "depth": len(self.queue),
+            "admitted": c["admitted"],
+            "rejected": c["rejected"],
+            "backfills": c["backfills"],
+            "deferred": len(self._deferred),
+            "retired": c["retired"],
+        }
+
+    def _live_by_owner(self) -> Dict[str, int]:
+        """Live (queued + running) job counts per owning tenant — the
+        quota denominator. Deferred jobs do not count (a tenant's own
+        holding pen must not block its promotions)."""
+        live: Dict[str, int] = {}
+        for j in self.state["jobs"].values():
+            if j["state"] in ("queued", "running"):
+                live[j["owner"]] = live.get(j["owner"], 0) + 1
+        return live
+
+    # -- revival --------------------------------------------------------------
+    def _revive(self) -> int:
+        """Load serve-state.json and re-queue every job the previous
+        daemon still owed work: queued/running -> the live queue
+        (running tenants resume from their newest snapshot — the ckpt
+        bit-identity contract), deferred -> the holding pen. Terminal
+        jobs (done/fault/rejected) are never touched."""
+        doc = state_mod.read_state(self.state_path)
+        if doc is None:
+            return 0
+        errs = state_mod.validate_state(doc)
+        if errs:
+            raise ValueError(
+                f"corrupt serve-state at {self.state_path}: "
+                + "; ".join(errs[:3]))
+        self.state = doc
+        n = 0
+        jobs = sorted(doc["jobs"].items(),
+                      key=lambda kv: kv[1].get("seq", 0))
+        for jid, j in jobs:
+            self._seq = max(self._seq, int(j.get("seq", 0)) + 1)
+            if j["state"] not in state_mod.LIVE_STATES:
+                continue
+            job = job_from_doc(j["spec"], int(j.get("seq", 0)))
+            n += 1
+            if j["state"] == "deferred":
+                self._deferred.append(job)
+                self._register(job)
+            else:
+                j["state"] = "queued"  # running-at-crash resumes
+                self._enqueue(job, revived=True)
+        if n:
+            telemetry.get().meta(
+                "serve.revived", jobs=n, queued=len(self.queue),
+                deferred=len(self._deferred))
+            log.info(f"serve: revived {n} unserved job(s) from "
+                     f"{self.state_path}")
+        self._promote()
+        return n
+
+    # -- admission ------------------------------------------------------------
+    def _next_seq(self) -> int:
+        s = self._seq
+        self._seq += 1
+        return s
+
+    def _register(self, job: ServeJob) -> None:
+        self._jobs_by_id[job.tid] = job
+        self.jobs.append(job)  # driver-level registry (injector, summary)
+
+    def _enqueue(self, job: ServeJob, *, revived: bool = False,
+                 promoted: bool = False) -> None:
+        self.queue.admit(job)
+        if job.tid not in self._jobs_by_id:
+            self._register(job)
+        st = self.state["jobs"].setdefault(job.tid, {
+            "steps_done": 0, "owner": job.owner, "priority": job.priority,
+            "seq": job.seq, "spec": job.spec_doc(),
+        })
+        st["state"] = "queued"
+        if not revived:
+            self._counters()["admitted"] += 1
+            telemetry.get().meta(
+                "serve.admitted", job=job.tid, tenant=job.owner,
+                priority=job.priority, seq=job.seq,
+                deadline_ms=job.deadline_ms, promoted=promoted,
+                bucket=bucket_label(job.bucket()))
+
+    def _quarantine(self, path: str, jid: str, reason: str) -> None:
+        bad = self.intake.quarantine(path, reason)
+        self._counters()["rejected"] += 1
+        telemetry.get().meta("serve.rejected", job=jid, reason=reason,
+                             file=bad)
+        log.warn(f"serve: REJECTED job {jid!r}: {reason} "
+                 f"(quarantined: {bad})")
+
+    def _admit_one(self, path: str, doc, errs: List[str]) -> None:
+        stem = os.path.splitext(os.path.basename(path))[0]
+        if doc is None or errs:
+            self._quarantine(path, stem, "; ".join(errs) or "unreadable")
+            return
+        verrs = validate_job(doc)
+        jid = doc.get("job") if isinstance(doc.get("job"), str) else None
+        if verrs:
+            self._quarantine(path, jid or stem, "; ".join(verrs))
+            return
+        prior = self.state["jobs"].get(jid)
+        if prior is not None:
+            self._quarantine(
+                path, jid,
+                f"duplicate job id {jid!r} (already {prior['state']}); "
+                "a replayed job is never re-run")
+            return
+        job = job_from_doc(doc, self._next_seq())
+        verdict, reason = self.admission.decide(job, self._live_by_owner())
+        if verdict == "reject":
+            self.state["jobs"][jid] = {
+                "state": "rejected", "steps_done": 0, "owner": job.owner,
+                "priority": job.priority, "seq": job.seq, "reason": reason,
+            }
+            self._quarantine(path, jid, reason)
+            return
+        if verdict == "defer":
+            self._deferred.append(job)
+            self._register(job)
+            self.state["jobs"][jid] = {
+                "state": "deferred", "steps_done": 0, "owner": job.owner,
+                "priority": job.priority, "seq": job.seq,
+                "spec": job.spec_doc(), "reason": reason,
+            }
+            self._counters()["deferred"] += 1
+            telemetry.get().meta("serve.deferred", job=jid, reason=reason)
+            log.info(f"serve: deferred job {jid!r}: {reason}")
+            return
+        self._enqueue(job)
+
+    def _promote(self) -> bool:
+        """Move deferred jobs whose owner has quota headroom into the
+        queue (priority/deadline order) — the QUEUES-not-rejects half of
+        quota exhaustion."""
+        changed = False
+        live = self._live_by_owner()
+        for job in sorted(self._deferred, key=ServeJob.order_key):
+            q = self.admission.quota
+            if q and live.get(job.owner, 0) >= q:
+                continue
+            self._deferred.remove(job)
+            live[job.owner] = live.get(job.owner, 0) + 1
+            self._enqueue(job, promoted=True)
+            changed = True
+        return changed
+
+    # -- the driver's serving hooks -------------------------------------------
+    def _refresh_queue(self, queue) -> None:
+        """The steady-state intake pump (driver calls: per chunk, before
+        every backfill scan). Draining stops claiming — undropped jobs
+        stay in ``incoming/`` for the next daemon."""
+        if self._drain:
+            return
+        polled = self.intake.poll()
+        if not polled and not self._deferred:
+            return
+        for path, doc, errs in polled:
+            self._admit_one(path, doc, errs)
+        promoted = self._promote()
+        if polled or promoted:
+            self._flush_state()
+            telemetry.get().gauge("serve.queue_depth",
+                                  float(len(self.queue)), phase="serve")
+
+    def _observe_chunk(self, bucket, per: float, done_now: int) -> None:
+        self.pricer.observe(bucket, per)
+        self._all_lat.append(per)
+        self._check_pressure(bucket, done_now)
+        if self.status is not None:
+            # staged; run_guarded's per-chunk update flushes atomically
+            self.status.set(queue=self.queue_stat())
+
+    def _check_pressure(self, bucket, done_now: int) -> None:
+        """Deadline-at-risk -> a first-class replan trigger: any queued
+        or RUNNING job of this bucket whose deadline sits under the
+        online p99 latches the ReplanController (once per bucket per
+        swap window — pressure is a condition, not a siren)."""
+        label = bucket_label(bucket)
+        if label in self._pressure_sent:
+            return
+        priced = self.pricer.price(bucket)
+        if priced is None:
+            return
+        p99_ms, source = priced
+        candidates = list(self.queue) + [
+            self._jobs_by_id[t] for t in sorted(self._running)
+            if t in self._jobs_by_id]
+        at_risk = sorted(j.tid for j in candidates
+                         if j.bucket() == bucket and j.deadline_ms is not None
+                         and float(j.deadline_ms) < p99_ms)
+        if not at_risk:
+            return
+        self._pressure_sent.add(label)
+        telemetry.get().meta(
+            "replan.requested", reason="slo-pressure", step=int(done_now),
+            bucket=label, p99_ms=float(p99_ms), jobs=at_risk,
+            priced_from=source)
+        log.warn(f"serve: SLO PRESSURE on bucket {label}: p99 "
+                 f"{p99_ms:.4g} ms puts {at_risk} at deadline risk "
+                 "(replan requested)")
+        if self.replan is not None:
+            self.replan.request({"metric": "slo-pressure", "bucket": label,
+                                 "p99_ms": float(p99_ms),
+                                 "step": int(done_now), "jobs": at_risk})
+
+    def _mark_running(self, job: ServeJob) -> None:
+        self._running.add(job.tid)
+        st = self.state["jobs"].get(job.tid)
+        if st is not None:
+            st["state"] = "running"
+
+    def _on_backfill(self, job, lane_idx: int, slot_step: int) -> None:
+        self._counters()["backfills"] += 1
+        self._mark_running(job)
+        self._flush_state()
+
+    def _on_result(self, r: TenantResult) -> None:
+        """Stream the result the moment it exists: atomic
+        ``results/<job>.json``, a ``serve.retired`` record, quota
+        promotion, durable state."""
+        self._running.discard(r.tid)
+        st = self.state["jobs"].get(r.tid)
+        if st is not None:
+            st["state"] = r.outcome  # "done" | "fault"
+            st["steps_done"] = int(r.steps)
+        self._counters()["retired"] += 1
+        self._retired_run += 1
+        job = self._jobs_by_id.get(r.tid)
+        self._write_result_doc(r, job)
+        telemetry.get().meta(
+            "serve.retired", job=r.tid, outcome=r.outcome,
+            steps=int(r.steps), snapshot_dir=r.snapshot_dir,
+            tenant=job.owner if job is not None else r.tid)
+        self._promote()
+        self._flush_state()
+
+    def _write_result_doc(self, r: TenantResult,
+                          job: Optional[ServeJob]) -> None:
+        doc = {
+            "v": 1, "kind": "serve-result", "job": r.tid,
+            "tenant": job.owner if job is not None else r.tid,
+            "outcome": r.outcome, "steps": int(r.steps),
+            "snapshot_dir": r.snapshot_dir, "evidence": r.evidence,
+            "t": time.time(),
+        }
+        os.makedirs(self.results_dir, exist_ok=True)
+        tmp = os.path.join(self.results_dir,
+                           f".tmp-{r.tid}.json-{os.getpid()}")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.results_dir,
+                                         f"{r.tid}.json"))
+        except OSError:
+            pass  # streaming is evidence; the snapshot dir is the truth
+
+    def _segment_end(self, slot_step: int, end: int) -> int:
+        # chunk-granular segments: the park check (and backfill scan)
+        # runs every fused chunk, so SIGTERM drains at the next chunk
+        # boundary instead of waiting out a whole tenant's remaining
+        # steps — drain latency is one chunk, bounded and small
+        return min(end, slot_step + self.chunk)
+
+    def _should_park(self) -> bool:
+        return self._drain
+
+    def _on_park(self, job, tenant_step: int) -> None:
+        self._running.discard(job.tid)
+        st = self.state["jobs"].get(job.tid)
+        if st is not None:
+            st["state"] = "queued"
+            st["steps_done"] = int(tenant_step)
+        # back into the live queue: the in-memory view must agree with
+        # the durable state (the drain log and summary count it as owed)
+        self.queue.admit(job)
+        telemetry.get().meta("serve.parked", job=job.tid,
+                             step=int(tenant_step))
+        log.info(f"serve: parked job {job.tid} at step {tenant_step} "
+                 "(revivable)")
+
+    # -- the serve loop -------------------------------------------------------
+    def serve(self) -> dict:
+        rec = telemetry.get()
+        os.makedirs(self.campaign_dir, exist_ok=True)
+        os.makedirs(self.results_dir, exist_ok=True)
+        revived = self._revive()
+        # the summary reports THIS run; the state counters (and the
+        # status queue section) stay cumulative across revivals
+        c0 = dict(self._counters())
+        results = self.results
+        lat: List[float] = []
+        cell_steps = 0
+        wall = 0.0
+        slot_idx = 0
+        t0 = time.perf_counter()
+        idle_since: Optional[float] = None
+        self._flush_state()
+        if self.status is not None:
+            self.status.update(queue=self.queue_stat())
+        while True:
+            if (self.max_wall_s > 0
+                    and time.perf_counter() - t0 >= self.max_wall_s):
+                self.request_drain("max-wall")
+            self._refresh_queue(self.queue)
+            if self._drain:
+                break
+            if not self.queue:
+                now = time.monotonic()
+                if idle_since is None:
+                    idle_since = now
+                if (self.max_idle_s > 0
+                        and now - idle_since >= self.max_idle_s):
+                    break
+                if self.status is not None:
+                    self.status.update(queue=self.queue_stat())
+                time.sleep(self.poll_s)
+                continue
+            idle_since = None
+            bucket, picked = pick_serve_slot(self.queue, self.slot_size)
+            self._last_bucket = bucket
+            for j in picked:
+                self._mark_running(j)
+            self._flush_state()
+            stats = self._run_slot(slot_idx, bucket, picked, self.queue,
+                                   results)
+            lat.extend(stats["latency_samples"])
+            cell_steps += stats["cell_steps"]
+            wall += stats["wall_s"]
+            slot_idx += 1
+            if self.replan is not None and self.replan.pending:
+                # between slots — the campaign's swap boundary; a swap
+                # re-arms the per-bucket pressure latch
+                self.replan.maybe_swap(None, slot_idx)
+                self._pressure_sent.clear()
+
+        outcome = "drained" if self._drain else "idle"
+        if self._drain:
+            rec.meta("serve.drain", reason=self._drain_reason or "requested",
+                     queued=len(self.queue), deferred=len(self._deferred))
+            log.info(f"serve: drained ({self._drain_reason}): "
+                     f"{len(self.queue)} queued + {len(self._deferred)} "
+                     "deferred job(s) persisted for revival")
+        if self.admission_ledger:
+            entries = self.pricer.ledger_entries(
+                platform=self.devices[0].platform,
+                label=rec.run_id or "serve")
+            if entries:
+                ledger_mod.append_entries(self.admission_ledger, entries)
+        total_wall = time.perf_counter() - t0
+        tph = (self._retired_run / total_wall * 3600.0
+               if total_wall > 0 else 0.0)
+        p50 = percentile(self._all_lat, 50) if self._all_lat else None
+        p99 = percentile(self._all_lat, 99) if self._all_lat else None
+        if self._retired_run and rec.enabled:
+            rec.gauge("serve.tenants_per_hour", tph, phase="serve")
+        if p99 is not None and rec.enabled:
+            rec.gauge("serve.p99_ms", p99 * 1e3, phase="serve", unit="ms")
+        c = self._counters()
+        summary = {
+            "outcome": outcome,
+            "revived": revived,
+            "slots": slot_idx,
+            "retired": self._retired_run,
+            "admitted": c["admitted"] - c0["admitted"],
+            "rejected": c["rejected"] - c0["rejected"],
+            "deferred": c["deferred"] - c0["deferred"],
+            "backfills": c["backfills"] - c0["backfills"],
+            "queued_remaining": len(self.queue) + len(self._deferred),
+            "tenants_per_hour": tph,
+            "p50_step_s": p50,
+            "p99_step_s": p99,
+            "evicted": sorted(t for t, r in results.items()
+                              if r.outcome == "fault"),
+            "slo_violations": sorted(self._slo_violated),
+            "anomalies": (self.sentinel.detected_total
+                          if self.sentinel is not None else 0),
+            "cell_steps": cell_steps,
+            "step_wall_s": wall,
+            "total_wall_s": total_wall,
+            "aggregate_mcells_per_s": (cell_steps / wall / 1e6
+                                       if wall > 0 else 0.0),
+            "cache": self.cache.stats(),
+            "results": results,
+        }
+        self._flush_state()
+        if self.status is not None:
+            self.status.update(outcome=outcome, queue=self.queue_stat())
+        return summary

@@ -1,0 +1,447 @@
+"""Always-on serving (stencil_tpu/serve/).
+
+The ISSUE-19 acceptance pins:
+
+- file-drop intake feeds a LIVE queue: jobs dropped while a slot runs
+  are admitted and backfilled into freed lanes MID-SLOT — no slot-wide
+  barrier (one slot serves them all);
+- malformed / duplicate job files never kill the daemon: truncated
+  JSON, an unknown workload, and a replayed job id are quarantined to
+  ``jobs/bad/`` with a reason file and a schema-valid ``serve.rejected``
+  record;
+- admission edge cases: quota exhaustion DEFERS (and promotes when the
+  tenant's job retires) rather than rejects; priority classes reorder
+  only queued jobs, never a running lane; a deadline infeasible against
+  the ledger's p99 is rejected AT ADMISSION with the pricing named;
+- SLO pressure (online p99 over a running job's deadline) emits a
+  first-class ``replan.requested``;
+- graceful drain parks live lanes as revivable snapshots, and a
+  revived daemon finishes them bit-identical to an uninterrupted serve
+  while never re-running retired jobs;
+- the status schema's ``queue`` section validates and renders.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+import jax
+
+from stencil_tpu.obs import ledger as ledger_mod
+from stencil_tpu.obs import telemetry
+from stencil_tpu.obs.status import render_status, validate_status
+from stencil_tpu.obs.telemetry import validate_record
+from stencil_tpu.serve import (
+    BucketPricer,
+    ServeJob,
+    ServeQueue,
+    ServeScheduler,
+    make_state,
+    pick_serve_slot,
+    validate_state,
+    write_state,
+    read_state,
+)
+from stencil_tpu.serve.admission import LEDGER_METRIC, bucket_label
+
+N = 10
+STEPS = 4
+
+
+def job_doc(jid, *, size=N, steps=STEPS, tenant=None, priority="normal",
+            deadline_ms=None, workload="jacobi", seed=None, dtype="float32"):
+    doc = {"job": jid, "size": size, "steps": steps, "workload": workload,
+           "priority": priority, "dtype": dtype,
+           "seed": seed if seed is not None else abs(hash(jid)) % 1000}
+    if tenant:
+        doc["tenant"] = tenant
+    if deadline_ms is not None:
+        doc["deadline_ms"] = deadline_ms
+    return doc
+
+
+def drop(serve_dir, doc=None, *, name=None, text=None):
+    """The loadgen write contract: tmp + rename into jobs/incoming/."""
+    inc = os.path.join(serve_dir, "jobs", "incoming")
+    os.makedirs(inc, exist_ok=True)
+    name = name or f"{doc['job']}.json"
+    tmp = os.path.join(inc, f".tmp-{name}")
+    with open(tmp, "w") as f:
+        f.write(text if text is not None else json.dumps(doc))
+    os.replace(tmp, os.path.join(inc, name))
+
+
+def sched_for(serve_dir, slot=2, **kw):
+    kw.setdefault("devices", jax.devices()[:4])
+    kw.setdefault("chunk", 2)
+    kw.setdefault("max_idle_s", 0.3)
+    kw.setdefault("poll_s", 0.02)
+    return ServeScheduler(str(serve_dir), slot, **kw)
+
+
+def recs_of(path):
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    bad = [validate_record(r) for r in recs]
+    assert not any(bad), [b for b in bad if b]
+    return recs
+
+
+# -- queue policy (pure units) ------------------------------------------------
+
+
+def test_queue_orders_priority_deadline_arrival():
+    def j(tid, pri, dl, seq):
+        return ServeJob(tid, (N, N, N), STEPS, "float32", seed=0,
+                        deadline_ms=dl, owner=tid, priority=pri, seq=seq)
+
+    q = ServeQueue()
+    for job in (j("low-first", "low", None, 0),
+                j("norm-late", "normal", None, 3),
+                j("norm-tight", "normal", 1.0, 2),
+                j("high", "high", None, 1)):
+        q.admit(job)
+    # priority class first, then deadline (tightest first), then arrival
+    assert [x.tid for x in q] == ["high", "norm-tight", "norm-late",
+                                  "low-first"]
+
+    bucket, picked = pick_serve_slot(q, 3)
+    assert bucket == ((N, N, N), "float32", "jacobi")
+    assert [x.tid for x in picked] == ["high", "norm-tight", "norm-late"]
+    assert [x.tid for x in q] == ["low-first"]  # stays live for backfill
+
+
+def test_state_roundtrip_and_validation(tmp_path):
+    doc = make_state()
+    doc["jobs"]["j1"] = {"state": "queued", "steps_done": 0, "owner": "a",
+                         "priority": "normal", "seq": 0,
+                         "spec": job_doc("j1")}
+    path = str(tmp_path / "serve-state.json")
+    write_state(path, doc)
+    back = read_state(path)
+    assert back is not None and validate_state(back) == []
+    assert back["jobs"]["j1"]["state"] == "queued"
+
+    assert validate_state([]) == ["not an object: list"]
+    bad = make_state()
+    bad["counters"]["admitted"] = True  # bool is not an int here
+    bad["jobs"]["x"] = {"state": "sleeping", "steps_done": 0, "owner": "a",
+                        "priority": "normal", "seq": 0, "spec": {}}
+    errs = validate_state(bad)
+    assert any("counters.admitted" in e for e in errs)
+    assert any("'sleeping'" in e for e in errs)
+
+
+# -- continuous batching: mid-slot admission, no slot-wide barrier ------------
+
+
+class LateDropScheduler(ServeScheduler):
+    """Drops extra job files at the FIRST chunk boundary — the in-process
+    stand-in for a producer writing while the slot is mid-flight."""
+
+    def __init__(self, *a, late=(), **kw):
+        super().__init__(*a, **kw)
+        self._late = list(late)
+
+    def _observe_chunk(self, bucket, per, done_now):
+        super()._observe_chunk(bucket, per, done_now)
+        while self._late:
+            drop(self.serve_dir, self._late.pop())
+
+
+def test_mid_slot_admission_backfills_without_barrier(tmp_path):
+    sdir = str(tmp_path / "s")
+    for i in range(2):
+        drop(sdir, job_doc(f"early{i}"))
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        out = LateDropScheduler(
+            sdir, 2, late=[job_doc("late0"), job_doc("late1")],
+            devices=jax.devices()[:4], chunk=2,
+            max_idle_s=0.3, poll_s=0.02).serve()
+    finally:
+        telemetry.get().close()
+    # all four retired inside ONE slot: the late pair was admitted while
+    # the slot ran and landed in freed lanes — zero slot-wide barriers
+    assert out["retired"] == 4 and out["slots"] == 1
+    assert out["backfills"] >= 2
+    recs = recs_of(m)
+    names = [r["name"] for r in recs]
+    slot0 = names.index("campaign.slot")
+    late_admits = [i for i, r in enumerate(recs)
+                   if r["name"] == "serve.admitted"
+                   and r["job"].startswith("late")]
+    assert late_admits and all(i > slot0 for i in late_admits)
+    backfilled = {r["tenant"] for r in recs
+                  if r["name"] == "campaign.backfill"}
+    assert {"late0", "late1"} <= backfilled
+    for jid in ("early0", "early1", "late0", "late1"):
+        res = json.load(open(os.path.join(sdir, "results", f"{jid}.json")))
+        assert res["outcome"] == "done" and res["steps"] == STEPS
+
+
+# -- quarantine: malformed and duplicate jobs never kill the daemon -----------
+
+
+def test_malformed_and_duplicate_jobs_quarantined(tmp_path):
+    sdir = str(tmp_path / "s")
+    drop(sdir, job_doc("good"))
+    drop(sdir, None, name="torn.json", text='{"job": "torn", "size": 8')
+    drop(sdir, job_doc("weird", workload="jacobi") | {"workload": "brew"},
+         name="weird.json")
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        out = sched_for(sdir).serve()
+        # the daemon survived both bad drops and served the good job
+        assert out["retired"] == 1 and out["rejected"] == 2
+
+        # replay the RETIRED job id: a revived-or-running daemon must
+        # quarantine it as a duplicate, never re-run it
+        drop(sdir, job_doc("good"))
+        out2 = sched_for(sdir).serve()
+        assert out2["retired"] == 0 and out2["rejected"] == 1
+        assert out2["revived"] == 0
+    finally:
+        telemetry.get().close()
+
+    bad_dir = os.path.join(sdir, "jobs", "bad")
+    quarantined = sorted(os.listdir(bad_dir))
+    reasons = {}
+    for n in quarantined:
+        if n.endswith(".reason.txt"):
+            reasons[n] = open(os.path.join(bad_dir, n)).read()
+    assert any("not valid JSON" in v for v in reasons.values())
+    assert any("unknown workload 'brew'" in v for v in reasons.values())
+    assert any("duplicate job id 'good'" in v for v in reasons.values())
+
+    rejected = [r for r in recs_of(m) if r["name"] == "serve.rejected"]
+    assert len(rejected) == 3
+    by_job = {r["job"]: r["reason"] for r in rejected}
+    assert "not valid JSON" in by_job["torn"]
+    assert "unknown workload" in by_job["weird"]
+    assert "duplicate" in by_job["good"]
+
+
+# -- admission edge cases -----------------------------------------------------
+
+
+def test_quota_exhaustion_defers_then_promotes(tmp_path):
+    sdir = str(tmp_path / "s")
+    for i in range(3):
+        drop(sdir, job_doc(f"q{i}", tenant="alice", steps=3))
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        out = sched_for(sdir, slot=1, quota=1).serve()
+    finally:
+        telemetry.get().close()
+    # over-quota jobs queued (deferred), never rejected — and every one
+    # was eventually promoted and served
+    assert out["rejected"] == 0
+    assert out["retired"] == 3
+    assert out["deferred"] == 2
+    recs = recs_of(m)
+    deferred = [r for r in recs if r["name"] == "serve.deferred"]
+    assert {r["job"] for r in deferred} == {"q1", "q2"}
+    assert all("quota" in r["reason"] for r in deferred)
+    # promotion happens at retirement: each deferred job's (promoted)
+    # admission comes after some retirement record
+    names = [r["name"] for r in recs]
+    first_retire = names.index("serve.retired")
+    promoted = [i for i, r in enumerate(recs)
+                if r["name"] == "serve.admitted" and r.get("promoted")]
+    assert len(promoted) == 2 and all(i > first_retire for i in promoted)
+
+
+def test_priority_reorders_queued_never_running(tmp_path):
+    sdir = str(tmp_path / "s")
+    # a low-priority job is already RUNNING when a high-priority one
+    # arrives mid-slot: the running lane is never preempted — the high
+    # job waits for the lane to free, then backfills
+    drop(sdir, job_doc("slowpoke", priority="low", steps=6))
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        out = LateDropScheduler(
+            sdir, 1, late=[job_doc("urgent", priority="high", steps=2)],
+            devices=jax.devices()[:4], chunk=2,
+            max_idle_s=0.3, poll_s=0.02).serve()
+    finally:
+        telemetry.get().close()
+    assert out["retired"] == 2
+    recs = recs_of(m)
+    retire_order = [r["job"] for r in recs if r["name"] == "serve.retired"]
+    # the running low-priority tenant finished first, at its FULL step
+    # count — priority reordered only the queue, never the lane
+    assert retire_order == ["slowpoke", "urgent"]
+    slow = [r for r in recs if r["name"] == "serve.retired"
+            and r["job"] == "slowpoke"][0]
+    assert slow["steps"] == 6
+    assert not any(r["name"] == "serve.parked" for r in recs)
+
+
+def test_infeasible_deadline_rejected_with_pricing_named(tmp_path):
+    sdir = str(tmp_path / "s")
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    label = bucket_label(((N, N, N), "float32", "jacobi"))
+    ledger_mod.append_entries(ledger_path, [ledger_mod.make_entry(
+        LEDGER_METRIC, 250.0, label="seed", unit="ms", platform="cpu",
+        source="serve", config={"bucket": label},
+        detail={"bucket": label, "samples": 64})])
+
+    # the pricer itself: ledger prior until online evidence exists
+    pricer = BucketPricer(ledger_path)
+    p99, source = pricer.price(((N, N, N), "float32", "jacobi"))
+    assert p99 == 250.0 and "ledger" in source and "[seed]" in source
+
+    drop(sdir, job_doc("doomed", deadline_ms=1.0))  # 1 ms vs p99 250 ms
+    # 6 steps / chunk 2 = 3 chunks: enough online samples (min 3) for
+    # the drain-time ledger writeback asserted below
+    drop(sdir, job_doc("fine", deadline_ms=5000.0, steps=6))  # feasible
+    drop(sdir, job_doc("nosla", steps=6))           # no deadline at all
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        out = sched_for(sdir, admission_ledger=ledger_path).serve()
+    finally:
+        telemetry.get().close()
+    assert out["rejected"] == 1 and out["retired"] == 2
+    rej = [r for r in recs_of(m) if r["name"] == "serve.rejected"]
+    assert len(rej) == 1 and rej[0]["job"] == "doomed"
+    # the rejection NAMES its price and where it came from
+    assert "deadline 1 ms infeasible" in rej[0]["reason"]
+    assert "p99 is 250 ms" in rej[0]["reason"]
+    assert "ledger" in rej[0]["reason"]
+    st = read_state(os.path.join(sdir, "serve-state.json"))
+    assert st["jobs"]["doomed"]["state"] == "rejected"
+    assert not os.path.exists(os.path.join(sdir, "results", "doomed.json"))
+    # drain-time writeback: the daemon's own online p99 joined the ledger
+    entries = [e for e in ledger_mod.load_ledger(ledger_path)
+               if e["metric"] == LEDGER_METRIC]
+    assert any(e["source"] == "serve" and e["label"] != "seed"
+               for e in entries)
+
+
+# -- SLO pressure -> replan.requested -----------------------------------------
+
+
+def test_slo_pressure_emits_replan_requested(tmp_path):
+    sdir = str(tmp_path / "s")
+    # unpriceable at admission (no ledger), but the online p99 will dwarf
+    # a microsecond deadline within the first slot
+    drop(sdir, job_doc("pressed", deadline_ms=0.001, steps=8))
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        out = sched_for(sdir).serve()
+    finally:
+        telemetry.get().close()
+    assert out["retired"] == 1  # pressure reschedules, it never kills
+    req = [r for r in recs_of(m) if r["name"] == "replan.requested"]
+    assert req, "SLO pressure must fire a first-class replan.requested"
+    assert req[0]["reason"] == "slo-pressure"
+    assert req[0]["bucket"] == bucket_label(((N, N, N), "float32", "jacobi"))
+    assert req[0]["p99_ms"] > 0.001
+    assert req[0]["jobs"] == ["pressed"]
+
+
+# -- graceful drain + revival -------------------------------------------------
+
+
+class DrainingScheduler(ServeScheduler):
+    """Requests a drain at the first chunk boundary — the in-process
+    stand-in for SIGTERM arriving mid-slot."""
+
+    def _observe_chunk(self, bucket, per, done_now):
+        super()._observe_chunk(bucket, per, done_now)
+        self.request_drain("test-sigterm")
+
+
+def test_drain_parks_and_revival_finishes_bit_identical(tmp_path):
+    jobs = [job_doc(f"d{i}", steps=6, seed=40 + i) for i in range(3)]
+
+    ref_dir = str(tmp_path / "ref")
+    for d in jobs:
+        drop(ref_dir, d)
+    ref = sched_for(ref_dir, slot=2, ckpt_every=2).serve()
+    assert ref["retired"] == 3
+
+    sdir = str(tmp_path / "s")
+    for d in jobs:
+        drop(sdir, d)
+    m = tmp_path / "m.jsonl"
+    telemetry.configure(metrics_out=str(m), app="t")
+    try:
+        out1 = DrainingScheduler(sdir, 2, devices=jax.devices()[:4],
+                                 chunk=2, ckpt_every=2,
+                                 max_idle_s=0.3, poll_s=0.02).serve()
+        out2 = sched_for(sdir, slot=2, ckpt_every=2).serve()
+    finally:
+        telemetry.get().close()
+
+    # the drained daemon parked mid-trajectory and persisted the queue
+    assert out1["outcome"] == "drained"
+    assert out1["retired"] == 0 and out1["queued_remaining"] == 3
+    recs = recs_of(m)
+    parked = [r for r in recs if r["name"] == "serve.parked"]
+    assert parked and all(0 < r["step"] < 6 for r in parked)
+    assert any(r["name"] == "serve.drain"
+               and r["reason"] == "test-sigterm" for r in recs)
+
+    # the revived daemon owed exactly those jobs and finished them
+    # bit-identical to the uninterrupted serve
+    assert out2["revived"] == 3 and out2["retired"] == 3
+    assert any(r["name"] == "serve.revived" and r["jobs"] == 3
+               for r in recs)
+    for jid in ("d0", "d1", "d2"):
+        a = out2["results"][jid]
+        b = ref["results"][jid]
+        assert a.outcome == b.outcome == "done"
+        assert a.final.tobytes() == b.final.tobytes(), jid
+    st = read_state(os.path.join(sdir, "serve-state.json"))
+    assert validate_state(st) == []
+    assert all(j["state"] == "done" for j in st["jobs"].values())
+
+
+# -- status schema: the queue section -----------------------------------------
+
+
+def test_status_queue_section_validates_and_renders(tmp_path):
+    sdir = str(tmp_path / "s")
+    drop(sdir, job_doc("one"))
+    status_path = str(tmp_path / "status.json")
+    from stencil_tpu.obs.status import StatusWriter
+
+    out = sched_for(sdir, status=StatusWriter(status_path, app="serve",
+                                              run="r1")).serve()
+    assert out["retired"] == 1
+    doc = json.load(open(status_path))
+    assert validate_status(doc) == []
+    q = doc["queue"]
+    assert q["depth"] == 0 and q["admitted"] == 1
+    assert q["rejected"] == 0 and q["backfills"] == 0
+    text = render_status(doc)
+    assert "queue: depth=0 admitted=1 rejected=0 backfills=0" in text
+
+    # the schema authority rejects a malformed queue section
+    doc["queue"]["depth"] = True
+    assert any("queue.depth" in e for e in validate_status(doc))
+    doc.pop("queue")
+    assert validate_status(doc) == []  # queue stays optional (additive)
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ({"job": "a/b", "size": 8, "steps": 1}, "path-safe"),
+    ({"job": "a", "size": 0, "steps": 1}, "size"),
+    ({"job": "a", "size": 8, "steps": 1, "deadline_ms": -2}, "deadline_ms"),
+    ({"job": "a", "size": 8, "steps": 1, "priority": "urgent"}, "priority"),
+    ({"job": "a", "size": 8, "steps": 1, "shape": 3}, "unknown fields"),
+])
+def test_job_schema_rejects(bad, msg):
+    from stencil_tpu.serve import validate_job
+
+    errs = validate_job(bad)
+    assert errs and any(msg in e for e in errs), errs
