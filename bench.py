@@ -545,6 +545,87 @@ def _child_main(mode: str, resume: bool = False) -> int:
         except Exception as e:
             errors["serve"] = f"{type(e).__name__}: {e}"[:400]
 
+    # serve capacity engine A/B (ISSUE 20): the SAME seeded mixed-tenant
+    # queue — 16 SHALLOW buckets (2 normal tenants each at a distinct
+    # size 20..35, 16 steps) plus a 4-job high bucket — through the
+    # PR 19 fixed-slot daemon (B=8, head-of-queue buckets) and through
+    # the capacity engine (elastic width 2..16, scored cross-bucket
+    # packing, stride fairness). Shallow buckets are exactly where a
+    # fixed slot bleeds: every chunk boundary device_gets and zeros the
+    # FULL 8-lane batch for 2 live tenants, while the engine sizes each
+    # slot to its queue depth. Each config gets a WARM pass on a shared
+    # CompileCache first, so the measured pass prices scheduling and
+    # host transfer, not compilation. Tracked: serve_mixed_over_fixed
+    # (the >= 1.3x acceptance floor) and the high-priority p99 split
+    # (the engine must not buy throughput with the high class's
+    # latency).
+    serve_mixed_tph = serve_mixed_fixed_tph = 0.0
+    serve_mixed_ratio = 0.0
+    serve_mixed_hi_p99 = serve_mixed_fixed_hi_p99 = None
+    if leg("serve capacity engine (mixed tenants A/B)"):
+        try:
+            import math as _math
+            import tempfile as _tf
+
+            from stencil_tpu.campaign.compile_cache import CompileCache
+            from stencil_tpu.serve import ServeScheduler
+
+            def _mixed_drop(sdir):
+                incoming = os.path.join(sdir, "jobs", "incoming")
+                os.makedirs(incoming, exist_ok=True)
+                docs = [{"job": f"n-{b:02d}-{j}", "size": 20 + b,
+                         "steps": 16, "dtype": "float32",
+                         "workload": "jacobi", "seed": b * 7 + j,
+                         "tenant": f"tenant-{b % 4}",
+                         "priority": "normal"}
+                        for b in range(16) for j in range(2)]
+                docs += [{"job": f"h-{i:04d}", "size": 10, "steps": 8,
+                          "dtype": "float32", "workload": "jacobi",
+                          "seed": 100 + i, "tenant": "tenant-hi",
+                          "priority": "high"} for i in range(4)]
+                for doc in docs:
+                    tmp = os.path.join(incoming, f".tmp-{doc['job']}")
+                    with open(tmp, "w") as f:
+                        json.dump(doc, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(
+                        tmp, os.path.join(incoming, f"{doc['job']}.json"))
+                return len(docs)
+
+            def _mixed_serve(cache, **cfg):
+                sdir = _tf.mkdtemp(prefix="bench-serve-mixed-")
+                n_jobs = _mixed_drop(sdir)
+                ndevs = 8 if len(jax.devices()) >= 8 else 1
+                summ = ServeScheduler(
+                    sdir, 8, devices=jax.devices()[:ndevs], chunk=2,
+                    poll_s=0.02, max_idle_s=0.1, cache=cache,
+                    **cfg).serve()
+                if summ["retired"] != n_jobs:
+                    raise RuntimeError(
+                        f"mixed serve retired {summ['retired']}/{n_jobs}")
+                return summ
+
+            def _hi_p99(summ):
+                v = (summ.get("p99_ms_by_priority") or {}).get("high")
+                return v if v is not None and _math.isfinite(v) else None
+
+            engine_cfg = dict(slot_min=2, slot_max=16, packing=True,
+                              fairness=True)
+            cache_fixed, cache_engine = CompileCache(), CompileCache()
+            _mixed_serve(cache_fixed)                  # warm pass:
+            _mixed_serve(cache_engine, **engine_cfg)   # compiles cached
+            fixed = _mixed_serve(cache_fixed)
+            eng = _mixed_serve(cache_engine, **engine_cfg)
+            serve_mixed_fixed_tph = fixed["tenants_per_hour"]
+            serve_mixed_tph = eng["tenants_per_hour"]
+            if serve_mixed_fixed_tph > 0:
+                serve_mixed_ratio = serve_mixed_tph / serve_mixed_fixed_tph
+            serve_mixed_hi_p99 = _hi_p99(eng)
+            serve_mixed_fixed_hi_p99 = _hi_p99(fixed)
+        except Exception as e:
+            errors["serve_mixed"] = f"{type(e).__name__}: {e}"[:400]
+
     # astaroth flagship details (BASELINE configs 4/4b): 8 fp32 fields,
     # fused Pallas RK3 substeps; skipped off-accelerator, via
     # STENCIL_BENCH_FAST=1, or when over budget (the three sliding-window
@@ -700,6 +781,20 @@ def _child_main(mode: str, resume: bool = False) -> int:
         "serve_tenants_per_hour": round(serve_tph, 1),
         "serve_p99_ms": (
             round(serve_p99_ms, 3) if serve_p99_ms is not None else None
+        ),
+        # capacity-engine A/B: engine vs fixed-slot tenants/hour on the
+        # seeded mixed queue (>= 1.3 is the ISSUE 20 acceptance floor)
+        # and the high class's p99 under each scheduler
+        "serve_mixed_tenants_per_hour": round(serve_mixed_tph, 1),
+        "serve_mixed_fixed_tenants_per_hour": round(serve_mixed_fixed_tph, 1),
+        "serve_mixed_over_fixed": round(serve_mixed_ratio, 3),
+        "serve_mixed_high_p99_ms": (
+            round(serve_mixed_hi_p99, 3)
+            if serve_mixed_hi_p99 is not None else None
+        ),
+        "serve_mixed_fixed_high_p99_ms": (
+            round(serve_mixed_fixed_hi_p99, 3)
+            if serve_mixed_fixed_hi_p99 is not None else None
         ),
         "astaroth_256_iter_ms": asta_ms,
         "astaroth_512_iter_ms": asta512_ms,

@@ -139,6 +139,45 @@ def main() -> int:
         if "r05" not in t.stdout or "83059.7" not in t.stdout:
             raise SystemExit(f"[perf-gate] committed LEDGER.jsonl does not "
                              f"render the r05 flagship:\n{t.stdout}")
+        # the serve capacity engine's A/B claim (ISSUE 20): the r25 rows
+        # must record the elastic engine >= 1.3x the fixed-slot baseline
+        # on the mixed-tenant leg
+        t = run([PY, "-m", "stencil_tpu.apps.perf_tool", "trend",
+                 "--ledger", os.path.join(REPO, "LEDGER.jsonl"),
+                 "--metric", "serve_mixed_over_fixed"],
+                name="trend-serve-mixed")
+        if "r25" not in t.stdout:
+            raise SystemExit(f"[perf-gate] committed LEDGER.jsonl lacks the "
+                             f"r25 serve_mixed_over_fixed row:\n{t.stdout}")
+        ratios = [float(e["value"]) for e in
+                  (json.loads(ln) for ln in
+                   open(os.path.join(REPO, "LEDGER.jsonl")))
+                  if e.get("metric") == "serve_mixed_over_fixed"]
+        if not ratios or min(ratios) < 1.3:
+            raise SystemExit(f"[perf-gate] serve_mixed_over_fixed must stay "
+                             f">= 1.3 (the capacity engine's acceptance "
+                             f"floor); ledger has {ratios}")
+        # the committed leg-config must drive the sentinel over the two
+        # serve legs: every verdict present and direction-aware, rc 0
+        # (judged within band) or 2 (all SKIP while history < min_history
+        # — the first rounds); rc 1 is a regression trip and fails CI
+        cmd = [PY, "-m", "stencil_tpu.apps.perf_tool", "gate",
+               "--ledger", os.path.join(REPO, "LEDGER.jsonl"),
+               "--metric", "serve_mixed_tenants_per_hour",
+               "--metric", "serve_mixed_high_p99_ms",
+               "--leg-config", os.path.join(REPO, "perf-legs.json")]
+        print(f"[perf-gate] gate-serve-legs: {' '.join(cmd)}", flush=True)
+        g = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+        if g.returncode not in (0, 2):
+            print(g.stdout)
+            print(g.stderr, file=sys.stderr)
+            raise SystemExit(f"[perf-gate] serve-leg sentinel tripped "
+                             f"(rc={g.returncode})")
+        for leg in ("serve_mixed_tenants_per_hour",
+                    "serve_mixed_high_p99_ms"):
+            if f"GATE FAIL {leg}" in g.stdout or leg not in g.stdout:
+                raise SystemExit(f"[perf-gate] serve-leg sentinel verdict "
+                                 f"wrong for {leg}:\n{g.stdout}")
         # corruption must be rejected loudly, not aggregated
         bad = os.path.join(work, "bad-ledger.jsonl")
         shutil.copyfile(os.path.join(REPO, "LEDGER.jsonl"), bad)

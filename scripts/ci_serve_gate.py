@@ -31,7 +31,21 @@ no TPU needed:
    ``replan.requested`` with reason ``slo-pressure`` and hot-swap a
    plan between slots (``replan.applied``, trigger ``slo-pressure``)
    persisted into ``--plan-db``;
-5. every metrics file passes ``report --validate``.
+5. **priced preemption, bit-identical** (ISSUE 20): a high-priority
+   deadline job dropped mid-slot against a seeded pricing ledger must
+   preempt the running slot at a chunk boundary (``serve.preempted``
+   with ``gain_ms > resume_cost_ms``, both victims ``serve.parked``
+   with reason ``preempt`` mid-flight), and every tenant's final
+   snapshot — victims included — must be bit-identical to an
+   undisturbed ``--no-preempt`` reference serve of the same seeded
+   load (``ckpt_tool diff --data``);
+6. **elastic slot width**: a ``--slot-min 2 --slot-max 8`` daemon
+   grows a running width-2 slot when 6 same-bucket jobs land mid-slot
+   (``serve.resized`` reason ``grow``, lanes parked with reason
+   ``resize``), and a later wave revisiting the grown width compiles
+   NOTHING new — every ``compile.build`` key (which carries the slot
+   width as ``batch``) is built exactly once across the daemon's life;
+7. every metrics file passes ``report --validate``.
 
 Exit 0 only if every stage holds. Run from the repo root:
 
@@ -134,6 +148,59 @@ def retired_jobs(*metric_paths):
         out.extend(r["job"] for r in by_name(load_records(path),
                                              "serve.retired"))
     return out
+
+
+def drop_doc(serve_dir, doc):
+    """Atomically drop one job document (the loadgen write contract;
+    used directly when a stage needs a field loadgen has no flag for,
+    e.g. an explicit priority)."""
+    incoming = os.path.join(serve_dir, "jobs", "incoming")
+    os.makedirs(incoming, exist_ok=True)
+    tmp = os.path.join(incoming, f".tmp-{doc['job']}-{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(incoming, f"{doc['job']}.json"))
+
+
+def seed_pricing_ledger(path, prices):
+    """Seed ``serve.step_p99_ms`` bucket priors WITHOUT importing
+    stencil_tpu (the gate process never pays the jax import): plain v1
+    rows in the obs/ledger.py schema, keyed by ``detail.bucket`` —
+    exactly what BucketPricer loads."""
+    with open(path, "w") as f:
+        for i, (bucket, ms) in enumerate(sorted(prices.items())):
+            f.write(json.dumps({
+                "v": 1, "kind": "perf-ledger",
+                "metric": "serve.step_p99_ms", "value": float(ms),
+                "unit": "ms", "platform": "cpu",
+                "config": f"seed-{bucket}", "rev": None, "label": "seed",
+                "source": "serve", "t": float(i + 1), "run": None,
+                "detail": {"bucket": bucket, "samples": 8},
+            }, sort_keys=True) + "\n")
+
+
+def poll_daemon(cmd, status_path, out_path, err_path, on_status):
+    """Run a daemon to completion, feeding every status snapshot to
+    ``on_status`` (output to FILES, not pipes — the stage-1 deadlock
+    rule). Returns the daemon's JSON summary."""
+    print(f"[serve-gate] daemon (polled): {' '.join(cmd)}", flush=True)
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=out_f, stderr=err_f,
+                                text=True)
+        while proc.poll() is None:
+            doc = read_status(status_path)
+            if doc:
+                on_status(doc)
+            time.sleep(0.05)
+        proc.wait()
+    if proc.returncode != 0:
+        with open(err_path) as f:
+            print(f.read()[-8000:], file=sys.stderr)
+        raise SystemExit(f"[serve-gate] polled daemon rc={proc.returncode}")
+    with open(out_path) as f:
+        return summary_of(f.read(), os.path.basename(out_path))
 
 
 def stage1_continuous_batching(work):
@@ -375,6 +442,188 @@ def stage4_slo_pressure_replan(work):
           f"{app[0].get('new')} persisted")
 
 
+def stage5_preemption_bit_identical(work):
+    """A rush high-deadline arrival preempts the running slot — priced
+    against the victims' resume cost off a SEEDED ledger — and the
+    parked victims resume to finals bit-identical to an undisturbed
+    ``--no-preempt`` reference of the same seeded load."""
+    lpath = os.path.join(work, "prices5.jsonl")
+    # victims' bucket priced slow, the rush bucket fast: waiting in
+    # queue provably breaks the rush budget, and the priced gain dwarfs
+    # two victims' resume cost
+    seed_pricing_ledger(lpath, {
+        f"{SIZE}x{SIZE}x{SIZE}/float32/jacobi": 100.0,
+        "10x10x10/float32/jacobi": 1.0,
+    })
+    rush = {"job": "rush", "size": 10, "steps": 2, "dtype": "float32",
+            "workload": "jacobi", "seed": 77, "tenant": "tenant-hi",
+            "priority": "high", "deadline_ms": 2.0}
+    steps = 12
+    extra = ("--admission-ledger", lpath, "--preempt-cost-chunks", "0.05")
+
+    ref = os.path.join(work, "s5-ref")
+    loadgen(ref, jobs=2, steps=steps, seed=21, tenants=2, prefix="vic")
+    drop_doc(ref, rush)
+    m_ref = os.path.join(work, "m5ref.jsonl")
+    g = run(serve_cmd(ref, m_ref, os.path.join(work, "status5r.json"),
+                      extra=("--no-preempt",) + extra),
+            name="preempt-reference")
+    if summary_of(g.stdout, "preempt-reference").get("retired") != 3:
+        raise SystemExit("[serve-gate] preempt reference must retire all 3")
+
+    live = os.path.join(work, "s5")
+    loadgen(live, jobs=2, steps=steps, seed=21, tenants=2, prefix="vic")
+    m5 = os.path.join(work, "m5.jsonl")
+    st5 = os.path.join(work, "status5.json")
+    state = {"dropped": False}
+
+    def on_status(doc):
+        if (not state["dropped"] and not doc.get("outcome")
+                and (doc.get("step") or 0) >= 2):
+            # the victim slot is observably RUNNING: now the rush job
+            # arrives — preemption must fire at a chunk boundary
+            drop_doc(live, rush)
+            state["dropped"] = True
+
+    summary = poll_daemon(
+        serve_cmd(live, m5, st5, extra=extra), st5,
+        os.path.join(work, "daemon5.out"), os.path.join(work, "daemon5.err"),
+        on_status)
+    if not state["dropped"]:
+        raise SystemExit("[serve-gate] stage 5 never saw a running slot "
+                         "to drop the rush job into")
+    if summary.get("retired") != 3 or summary.get("preemptions") != 1:
+        raise SystemExit(f"[serve-gate] want 3 retired / 1 preemption: "
+                         f"{summary}")
+    recs = load_records(m5)
+    pre = by_name(recs, "serve.preempted")
+    if len(pre) != 1 or pre[0].get("job") != "rush":
+        raise SystemExit(f"[serve-gate] want ONE serve.preempted for the "
+                         f"rush job: {pre}")
+    if not pre[0]["gain_ms"] > pre[0]["resume_cost_ms"]:
+        raise SystemExit(f"[serve-gate] preemption must only fire when "
+                         f"the priced gain exceeds the victims' resume "
+                         f"cost: {pre[0]}")
+    if sorted(pre[0].get("victims", [])) != ["vic-21-0000", "vic-21-0001"]:
+        raise SystemExit(f"[serve-gate] both victims must be named: "
+                         f"{pre[0]}")
+    parked = [r for r in by_name(recs, "serve.parked")
+              if r.get("reason") == "preempt"]
+    if len(parked) != 2 or not all(0 < r["step"] < steps for r in parked):
+        raise SystemExit(f"[serve-gate] want both victims parked "
+                         f"mid-flight (0 < step < {steps}): "
+                         f"{[(r.get('job'), r.get('step')) for r in parked]}")
+    for tid in ("vic-21-0000", "vic-21-0001", "rush"):
+        run([PY, "-m", "stencil_tpu.apps.ckpt_tool", "diff",
+             newest_snapshot(live, tid), newest_snapshot(ref, tid),
+             "--data"], name=f"diff5-{tid}")
+    run([PY, "-m", "stencil_tpu.apps.report", m5, "--validate"],
+        name="validate-5")
+    run([PY, "-m", "stencil_tpu.apps.report", m_ref, "--validate"],
+        name="validate-5ref")
+    print(f"[serve-gate] stage 5: rush preempted the slot (gain "
+          f"{pre[0]['gain_ms']:.4g} ms > resume cost "
+          f"{pre[0]['resume_cost_ms']:.4g} ms), both victims parked and "
+          f"resumed, all 3 finals bit-identical to the no-preempt "
+          f"reference")
+
+
+def stage6_elastic_resize(work):
+    """A width-2 slot grows to the queue's width mid-flight, and a
+    second wave revisiting the grown width recompiles NOTHING — one
+    ``compile.build`` per (bucket, width) for the daemon's whole life."""
+    lpath = os.path.join(work, "prices6.jsonl")
+    seed_pricing_ledger(lpath, {"12x12x12/float32/jacobi": 50.0})
+    sdir = os.path.join(work, "s6")
+    steps1 = 16
+    loadgen(sdir, jobs=2, steps=steps1, seed=31, tenants=2, size=12,
+            prefix="w1")
+    m6 = os.path.join(work, "m6.jsonl")
+    st6 = os.path.join(work, "status6.json")
+    state = {"wave2": False, "wave3": False, "wave4": False}
+
+    def on_status(doc):
+        q = doc.get("queue") or {}
+        mid_run = not doc.get("outcome")
+        if (not state["wave2"] and mid_run
+                and (doc.get("step") or 0) >= 2):
+            # the width-2 slot is RUNNING: 6 more same-bucket jobs make
+            # the queue wider than the slot — it must grow, not crawl.
+            # Dropped in-process (not via the loadgen subprocess): the
+            # whole wave must land while THIS slot is still mid-flight
+            for i in range(6):
+                drop_doc(sdir, {"job": f"w2-32-{i:04d}", "size": 12,
+                                "steps": 8, "dtype": "float32",
+                                "workload": "jacobi", "seed": 320 + i,
+                                "tenant": f"tenant-{i % 2}",
+                                "priority": "normal"})
+            state["wave2"] = True
+        if (state["wave2"] and not state["wave3"] and mid_run
+                and q.get("retired") == 8):
+            # everything retired, daemon idling: a second wave at the
+            # SAME depth revisits the grown width — a compile-cache hit
+            # by construction
+            loadgen(sdir, jobs=8, steps=8, seed=33, tenants=2, size=12,
+                    prefix="w3")
+            state["wave3"] = True
+        if (state["wave3"] and not state["wave4"] and mid_run
+                and q.get("retired") == 16):
+            # the surge is over: a 2-deep trickle must SHRINK the next
+            # slot back down the ladder (and hit the width-2 program)
+            loadgen(sdir, jobs=2, steps=8, seed=34, tenants=2, size=12,
+                    prefix="w4")
+            state["wave4"] = True
+
+    summary = poll_daemon(
+        serve_cmd(sdir, m6, st6, slot=2,
+                  extra=("--slot-min", "2", "--slot-max", "8",
+                         "--no-preempt", "--preempt-cost-chunks", "0.25",
+                         "--admission-ledger", lpath)),
+        st6, os.path.join(work, "daemon6.out"),
+        os.path.join(work, "daemon6.err"), on_status)
+    if not state["wave4"]:
+        raise SystemExit(f"[serve-gate] stage 6 never reached the later "
+                         f"waves: {state}")
+    if summary.get("retired") != 18 or not summary.get("resizes"):
+        raise SystemExit(f"[serve-gate] want 18 retired with >= 1 resize: "
+                         f"{summary}")
+    recs = load_records(m6)
+    grew = [r for r in by_name(recs, "serve.resized")
+            if r.get("reason") == "grow" and r.get("from_width") == 2]
+    if not grew:
+        raise SystemExit(f"[serve-gate] want a grow from width 2: "
+                         f"{by_name(recs, 'serve.resized')}")
+    shrank = [r for r in by_name(recs, "serve.resized")
+              if r.get("reason") == "shrink"]
+    if not shrank:
+        raise SystemExit(f"[serve-gate] the post-surge trickle must "
+                         f"shrink the slot back down the ladder: "
+                         f"{by_name(recs, 'serve.resized')}")
+    parked = [r for r in by_name(recs, "serve.parked")
+              if r.get("reason") == "resize"]
+    if not parked or not all(0 < r["step"] < steps1 for r in parked):
+        raise SystemExit(f"[serve-gate] the grow must park the running "
+                         f"lanes mid-flight: "
+                         f"{[(r.get('job'), r.get('step')) for r in parked]}")
+    builds = [r["key"] for r in by_name(recs, "compile.build")]
+    if len(builds) != len(set(builds)):
+        raise SystemExit(f"[serve-gate] a width revisit must be a cache "
+                         f"HIT — some program compiled twice: {builds}")
+    widths = {json.loads(k).get("batch") for k in builds} - {None}
+    slot_widths = {r.get("width") for r in by_name(recs, "campaign.slot")}
+    if len(widths) < 2 or 2 not in slot_widths or not (slot_widths - {2}):
+        raise SystemExit(f"[serve-gate] want slots at width 2 AND a grown "
+                         f"width, one program each: builds={sorted(widths)} "
+                         f"slots={sorted(slot_widths)}")
+    run([PY, "-m", "stencil_tpu.apps.report", m6, "--validate"],
+        name="validate-6")
+    print(f"[serve-gate] stage 6: grew 2 -> {grew[0].get('to_width')} "
+          f"mid-slot ({len(parked)} resize parks), second wave at the "
+          f"grown width recompiled nothing ({len(builds)} builds for "
+          f"widths {sorted(widths)}), post-surge trickle shrank back to "
+          f"{shrank[0].get('to_width')}")
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--out-dir", default="",
@@ -387,6 +636,8 @@ def main() -> int:
         stage2_sigterm_drain(work)
         stage3_kill_revive_bit_identical(work)
         stage4_slo_pressure_replan(work)
+        stage5_preemption_bit_identical(work)
+        stage6_elastic_resize(work)
         if args.out_dir:
             out = os.path.abspath(args.out_dir)
             os.makedirs(out, exist_ok=True)

@@ -34,6 +34,58 @@ import time
 PRIORITIES = ("high", "normal", "low")
 
 
+def parse_mix(spec: str):
+    """``--mix`` entries: comma-separated ``SIZE[/DTYPE[/WORKLOAD]]``
+    where SIZE is ``N`` (cubic) or ``XxYxZ``. Each job draws one entry
+    from the seeded rng, so a mixed-shape/dtype offered load replays
+    exactly. Returns ``[(size, dtype, workload), ...]``."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split("/")
+        if len(parts) > 3:
+            raise ValueError(f"bad --mix entry {entry!r} "
+                             "(want SIZE[/DTYPE[/WORKLOAD]])")
+        dims = parts[0].lower().split("x")
+        if len(dims) not in (1, 3) or not all(
+                d.isdigit() and int(d) >= 1 for d in dims):
+            raise ValueError(f"bad --mix size {parts[0]!r} "
+                             "(want N or XxYxZ)")
+        size = ([int(dims[0])] * 3 if len(dims) == 1
+                else [int(d) for d in dims])
+        dtype = parts[1] if len(parts) > 1 else "float32"
+        if dtype not in ("float32", "float64"):
+            raise ValueError(f"bad --mix dtype {dtype!r}")
+        workload = parts[2] if len(parts) > 2 else "jacobi"
+        if workload not in ("jacobi", "astaroth"):
+            raise ValueError(f"bad --mix workload {workload!r}")
+        out.append((size, dtype, workload))
+    if not out:
+        raise ValueError("--mix named no entries")
+    return out
+
+
+def burst_gaps(gaps, on_s: float, off_s: float):
+    """Reshape Poisson arrival gaps into an on/off duty cycle: arrivals
+    keep their seeded order and in-burst spacing, but any arrival that
+    would land in an OFF window slides to the start of the next ON
+    window — a deterministic transform of the same seeded gap list."""
+    period = on_s + off_s
+    out = []
+    t = 0.0
+    prev = 0.0
+    for g in gaps:
+        t += g
+        phase = t % period
+        if phase >= on_s:  # lands in the quiet half: slide to next burst
+            t += period - phase
+        out.append(t - prev)
+        prev = t
+    return out
+
+
 def drop_job(incoming: str, doc: dict) -> str:
     """Atomically drop one job document (the intake write contract)."""
     name = f"{doc['job']}.json"
@@ -79,6 +131,18 @@ def main(argv=None) -> int:
     p.add_argument("--mixed-priority", action="store_true",
                    help="draw priorities high/normal/low (seeded) instead "
                         "of all-normal")
+    p.add_argument("--mix", default="",
+                   help="multi-shape/dtype job mix: comma-separated "
+                        "SIZE[/DTYPE[/WORKLOAD]] entries (SIZE = N or "
+                        "XxYxZ), e.g. '12,16/float64'; each job draws "
+                        "one entry (seeded) — overrides --size/--dtype/"
+                        "--workload")
+    p.add_argument("--burst", default="",
+                   help="on/off duty-cycle arrivals as ON_S,OFF_S "
+                        "seconds, e.g. '1,2': the seeded Poisson gaps "
+                        "are reshaped so every arrival lands in an ON "
+                        "window — bursty offered load, same determinism "
+                        "(needs --rate > 0)")
     p.add_argument("--prefix", default="j",
                    help="job id prefix (ids are <prefix>-<seed>-<i>; two "
                         "generators with different seeds never collide)")
@@ -87,21 +151,49 @@ def main(argv=None) -> int:
         p.error(f"--jobs must be >= 1, got {args.jobs}")
     if args.rate < 0:
         p.error(f"--rate must be >= 0, got {args.rate}")
+    mix = None
+    if args.mix:
+        try:
+            mix = parse_mix(args.mix)
+        except ValueError as e:
+            p.error(str(e))
+    burst = None
+    if args.burst:
+        parts = args.burst.split(",")
+        try:
+            on_s, off_s = (float(parts[0]), float(parts[1]))
+        except (IndexError, ValueError):
+            p.error(f"bad --burst {args.burst!r} (want ON_S,OFF_S)")
+        if on_s <= 0 or off_s < 0:
+            p.error(f"--burst needs ON_S > 0 and OFF_S >= 0, "
+                    f"got {args.burst!r}")
+        if args.rate <= 0:
+            p.error("--burst shapes arrival times; it needs --rate > 0")
+        burst = (on_s, off_s)
 
     incoming = os.path.join(args.serve_dir, "jobs", "incoming")
     os.makedirs(incoming, exist_ok=True)
     rng = random.Random(args.seed)
+    # draw EVERY gap up front so --mix/--burst never perturb the seeded
+    # per-job draws (ids, owners, priorities stay replay-identical)
+    gaps = [0.0 if i == 0 else rng.expovariate(args.rate)
+            if args.rate > 0 else 0.0 for i in range(args.jobs)]
+    if burst is not None:
+        gaps = burst_gaps(gaps, burst[0], burst[1])
     t0 = time.perf_counter()
     dropped = []
     for i in range(args.jobs):
-        if args.rate > 0 and i > 0:
-            time.sleep(rng.expovariate(args.rate))
+        if args.rate > 0 and gaps[i] > 0:
+            time.sleep(gaps[i])
+        size, dtype, workload = (
+            rng.choice(mix) if mix is not None
+            else ([args.size] * 3, args.dtype, args.workload))
         doc = {
             "job": f"{args.prefix}-{args.seed}-{i:04d}",
-            "size": args.size,
+            "size": size,
             "steps": args.steps,
-            "dtype": args.dtype,
-            "workload": args.workload,
+            "dtype": dtype,
+            "workload": workload,
             "seed": rng.randrange(1 << 20),
             "tenant": f"tenant-{rng.randrange(args.tenants)}",
             "priority": (rng.choice(PRIORITIES) if args.mixed_priority

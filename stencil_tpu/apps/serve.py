@@ -5,10 +5,14 @@ it serves forever — producers drop job JSONs into
 ``<serve-dir>/jobs/incoming/`` (atomically: write a tmp file, rename;
 ``scripts/serve_loadgen.py`` is the reference producer), the daemon
 admits them against per-tenant ``--quota`` and ledger-priced deadlines,
-packs batch slots tightest-deadline-first, backfills retired lanes from
-the live queue MID-SLOT (continuous batching — no slot-wide barrier),
-and streams each result into ``<serve-dir>/results/<job>.json`` the
-moment the tenant retires.
+packs batch slots via the CAPACITY ENGINE (on by default: stride-
+weighted fairness with aging, scored cross-bucket packing, elastic slot
+width over ``--slot-min``/``--slot-max``, priced chunk-boundary
+preemption — each individually defeatable via ``--no-fairness`` /
+``--no-packing`` / ``--no-preempt`` and fixed width by default),
+backfills retired lanes from the live queue MID-SLOT (continuous
+batching — no slot-wide barrier), and streams each result into
+``<serve-dir>/results/<job>.json`` the moment the tenant retires.
 
 Lifecycle:
 
@@ -57,11 +61,26 @@ def build_scheduler(args, sentinel=None, status=None):
     from ..serve import ServeScheduler
 
     devices = jax.devices()[: args.cpu] if args.cpu else jax.devices()
+    weights = {}
+    for part in (args.fair_weights or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(f"bad --fair-weights entry {part!r} "
+                             "(want CLASS=WEIGHT)")
+        k, v = part.split("=", 1)
+        weights[k.strip()] = float(v)
     sched = ServeScheduler(
         args.serve_dir, args.slot,
         quota=args.quota, admission_ledger=args.admission_ledger or None,
         poll_s=args.poll_s, max_idle_s=args.max_idle_s,
         max_wall_s=args.max_wall_s,
+        slot_min=args.slot_min or None, slot_max=args.slot_max or None,
+        packing=not args.no_packing, preempt=not args.no_preempt,
+        fairness=not args.no_fairness, fair_weights=weights or None,
+        aging_s=args.aging_s,
+        preempt_cost_chunks=args.preempt_cost_chunks,
         devices=devices, chunk=args.chunk,
         ckpt_every=args.ckpt_every, ckpt_keep=args.ckpt_keep,
         health_every=args.health_every, max_abs=args.max_abs or None,
@@ -131,7 +150,44 @@ def main(argv: Optional[list] = None) -> int:
                         "campaign/ (slots + tenant snapshots), results/, "
                         "serve-state.json")
     p.add_argument("--slot", type=int, default=4,
-                   help="batch-slot size B (lanes per compiled program)")
+                   help="batch-slot size B (lanes per compiled program); "
+                        "with --slot-min/--slot-max this is only the "
+                        "elastic ladder's default")
+    p.add_argument("--slot-min", type=int, default=0,
+                   help="elastic width floor: each slot is sized to its "
+                        "bucket's queue depth on a power-of-two ladder "
+                        "from --slot-min to --slot-max (0 = --slot, "
+                        "i.e. fixed width)")
+    p.add_argument("--slot-max", type=int, default=0,
+                   help="elastic width ceiling; a mid-slot surge grows "
+                        "the running slot at a chunk boundary "
+                        "(park-repartition-revive, bit-identical) "
+                        "(0 = --slot)")
+    p.add_argument("--fair-weights", default="",
+                   help="served-share weights as CLASS=WEIGHT commas, "
+                        "e.g. 'high=8,normal=4,low=1' (the default); "
+                        "shares are stride-scheduled, so doubling a "
+                        "weight can only raise that class's share")
+    p.add_argument("--aging-s", type=float, default=30.0,
+                   help="seconds of queue wait that promote a job one "
+                        "priority class; a job waiting past "
+                        "aging_s*(rank+1) leads the next slot outright "
+                        "— the hard no-starvation bound (0 = no aging)")
+    p.add_argument("--no-fairness", action="store_true",
+                   help="strict priority order (PR 19): no weighted "
+                        "shares, no aging — sustained high load may "
+                        "starve low")
+    p.add_argument("--no-packing", action="store_true",
+                   help="head-of-queue bucket selection instead of the "
+                        "scored cross-bucket packing pass")
+    p.add_argument("--no-preempt", action="store_true",
+                   help="never park a running slot for an infeasible "
+                        "high arrival")
+    p.add_argument("--preempt-cost-chunks", type=float, default=1.0,
+                   help="priced resume cost per victim, in fused chunks "
+                        "of its bucket's p99 — preemption (and mid-slot "
+                        "growth) fires only when the priced gain "
+                        "exceeds this")
     p.add_argument("--chunk", type=int, default=2,
                    help="fused steps per dispatch")
     p.add_argument("--quota", type=int, default=0,

@@ -52,6 +52,10 @@ class CompileCache:
         self._progs: Dict[str, Any] = {}
         self.hits = 0
         self.misses = 0
+        # every key that caused a build, in build order — the elastic
+        # resize gate's "zero post-warmup recompiles" pin reads this
+        # (a width revisit must NOT append here)
+        self.built_keys: list = []
 
     def __len__(self) -> int:
         return len(self._progs)
@@ -67,6 +71,7 @@ class CompileCache:
             self.hits += 1
         else:
             self.misses += 1
+            self.built_keys.append(key)
             t0 = time.perf_counter()
             with rec.span("compile.build", phase="compile", key=key):
                 self._progs[key] = build()

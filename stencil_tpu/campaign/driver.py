@@ -366,6 +366,12 @@ class CampaignDriver:
         # once-per-tenant violation latch
         self._lane_lat: Dict[str, deque] = {}
         self._slo_violated: set = set()
+        # the RUNNING slot's lanes and width, published for the serving
+        # layer's chunk-boundary capacity decisions (preemption pricing
+        # needs the victims; per-width latency pricing needs the B that
+        # produced each sample). Batch campaigns run at slot_size.
+        self._cur_lanes: List[Lane] = []
+        self._cur_width: int = self.slot_size
 
     # -- serving extension points (stencil_tpu/serve/) ------------------------
     # The always-on scheduler (serve/scheduler.py) subclasses the driver
@@ -399,6 +405,15 @@ class CampaignDriver:
     def _on_backfill(self, job: "TenantJob", lane_idx: int,
                      slot_step: int) -> None:
         """A queued tenant just took over a freed lane mid-slot."""
+
+    def _backfill_gate(self, bucket) -> bool:
+        """May a freed lane refill from the queue right now? Serving
+        vetoes (False) when a job of a DIFFERENT bucket has aged past
+        its starvation bound: continuous batching would otherwise keep
+        a sustained same-bucket stream's slot alive forever, and the
+        waiting job could never enter. A veto lets the lane die so the
+        slot drains and the next packing pass serves the overdue job."""
+        return True
 
     def _segment_end(self, slot_step: int, end: int) -> int:
         """Cap a guarded segment's end step (must return in
@@ -457,25 +472,28 @@ class CampaignDriver:
 
     # -- compiled programs ----------------------------------------------------
     def _loop(self, spec: GridSpec, bucket, iters: int, sharding,
-              sel_sharding, devs: Sequence):
+              sel_sharding, devs: Sequence, batch: Optional[int] = None):
         from ..plan.ir import PlanConfig
 
         (size, dtype, workload) = bucket
         wl = WORKLOADS[workload]
+        b = int(batch) if batch else self.slot_size
         nq = len(wl.quantity_names(dtype))
         cfg = PlanConfig.make(Dim3(*size), spec.radius, [dtype] * nq,
                               len(devs), self.devices[0].platform)
         # device IDENTITY joins the key, not just the count: the jitted
         # loop's in_shardings pin a concrete mesh, and a shared cache
         # serving two drivers on disjoint same-sized device sets must
-        # never hand one the other's program
+        # never hand one the other's program. batch= keys the slot
+        # WIDTH, so an elastic daemon holds one program per (bucket,
+        # width) rung and a width revisit is a cache hit by construction
         key = cache_key(cfg, workload=f"{workload}-batched",
-                        batch=self.slot_size, iters=int(iters),
+                        batch=b, iters=int(iters),
                         pallas=self.use_pallas,
                         devices=[d.id for d in devs])
         return self.cache.get(key, lambda: wl.build_loop(
             spec, iters, sharding, sel_sharding,
-            batch=self.slot_size, use_pallas=self.use_pallas))
+            batch=b, use_pallas=self.use_pallas))
 
     # -- the campaign ---------------------------------------------------------
     def run(self) -> dict:
@@ -530,7 +548,11 @@ class CampaignDriver:
         return summary
 
     def _run_slot(self, slot_idx: int, bucket, initial: List[TenantJob],
-                  queue: deque, results: Dict[str, TenantResult]) -> dict:
+                  queue: deque, results: Dict[str, TenantResult],
+                  width: Optional[int] = None) -> dict:
+        """Run one slot. ``width`` overrides ``slot_size`` for THIS slot
+        only — the elastic serving path sizes each slot to its queue
+        depth; batch campaigns never pass it."""
         rec = telemetry.get()
         (size, dtype, workload) = bucket
         wl = WORKLOADS[workload]
@@ -544,7 +566,7 @@ class CampaignDriver:
                         aligned=self.use_pallas)
         p = spec.padded()
         off = spec.compute_offset()
-        B = self.slot_size
+        B = int(width) if width else self.slot_size
         devs = batch_devices(B, self.devices)
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -572,6 +594,8 @@ class CampaignDriver:
                 sel_sh = shr
 
         lanes = [Lane(i) for i in range(B)]
+        self._cur_lanes = lanes
+        self._cur_width = B
 
         def interior(padded: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
             return {
@@ -632,7 +656,7 @@ class CampaignDriver:
                  tenants=[l.tenant.tid for l in lanes if l.tenant],
                  bucket={"size": list(size), "dtype": dtype,
                          "workload": workload},
-                 devices=len(devs))
+                 devices=len(devs), width=B)
 
         def backfill(lane: Lane, slot_step: int, state: Dict):
             """Replace a retired/evicted lane from the queue (same bucket
@@ -640,11 +664,12 @@ class CampaignDriver:
             quantity dict — every quantity's lane moves together."""
             self._refresh_queue(queue)
             job = None
-            for cand in list(queue):
-                if cand.bucket() == bucket:
-                    job = cand
-                    queue.remove(cand)
-                    break
+            if self._backfill_gate(bucket):
+                for cand in list(queue):
+                    if cand.bucket() == bucket:
+                        job = cand
+                        queue.remove(cand)
+                        break
             if job is None:
                 lane.tenant = None
                 return {
@@ -679,7 +704,7 @@ class CampaignDriver:
         wall = 0.0
 
         def step_fn(st, k):
-            loop = self._loop(spec, bucket, k, sh, sel_sh, devs)
+            loop = self._loop(spec, bucket, k, sh, sel_sh, devs, B)
             out = wl.step(loop, st, scratch, sel)
             hard_sync(out)
             return out
@@ -864,6 +889,7 @@ class CampaignDriver:
                          step=int(job.steps), lane=l.idx, slot=slot_idx)
                 curr = backfill(l, slot_step, curr)
 
+        self._cur_lanes = []
         return {"latency_samples": lat, "cell_steps": cell_steps,
                 "wall_s": wall}
 

@@ -143,6 +143,13 @@ def validate_status(doc) -> List[str]:
                 v = q.get(fld)
                 if isinstance(v, bool) or not isinstance(v, int):
                     errs.append(f"queue.{fld} must be an integer")
+            # capacity-engine counters (additive, optional: older
+            # daemons never wrote them)
+            for fld in ("preempted", "resized", "width"):
+                v = q.get(fld)
+                if v is not None and (isinstance(v, bool)
+                                      or not isinstance(v, int)):
+                    errs.append(f"queue.{fld} must be an integer")
     return errs
 
 
@@ -240,6 +247,12 @@ def render_status(doc: dict, now: Optional[float] = None) -> str:
             qline += f" deferred={q['deferred']}"
         if isinstance(q.get("retired"), int):
             qline += f" retired={q['retired']}"
+        if isinstance(q.get("width"), int):
+            qline += f" width={q['width']}"
+        if isinstance(q.get("preempted"), int) and q["preempted"]:
+            qline += f" preempted={q['preempted']}"
+        if isinstance(q.get("resized"), int) and q["resized"]:
+            qline += f" resized={q['resized']}"
         lines.append(qline)
     for ev in (a or {}).get("active") or []:
         lines.append(

@@ -98,6 +98,18 @@ NAME_FIELDS = {
     "serve.parked": (("job", str), ("step", int)),
     "serve.drain": (("reason", str),),
     "serve.revived": (("jobs", int),),
+    # the capacity engine's decision records: every packed slot names
+    # its bucket/width/winner, every preemption (and every veto) names
+    # its priced gain against the victims' resume cost, every resize
+    # names both widths — "what was chosen and why" is a record, not a
+    # log line
+    "serve.packed": (("bucket", str), ("width", int)),
+    "serve.preempted": (("job", str), ("gain_ms", float),
+                        ("resume_cost_ms", float)),
+    "serve.preempt_veto": (("job", str), ("gain_ms", float),
+                           ("resume_cost_ms", float)),
+    "serve.resized": (("from_width", int), ("to_width", int),
+                      ("reason", str)),
     # the hot-swap half of ROADMAP #6 (plan/replan.ReplanController):
     # a mid-run replan either installs a new compiled plan (applied —
     # old/new choice labels + the static model's predicted gain rides
@@ -209,7 +221,8 @@ KNOWN_NAMES = frozenset(NAME_FIELDS) | frozenset({
     # the serving daemon's exit gauges: sustained completion rate and
     # per-step tail latency under open-loop arrivals (the ROADMAP #4
     # bench leg), plus the queue-depth gauge the dashboard trends
-    "serve.p99_ms", "serve.queue_depth", "serve.tenants_per_hour",
+    "serve.p99_ms", "serve.queue_depth", "serve.slot_width",
+    "serve.tenants_per_hour",
     "wire_ab.bytes_ratio", "wire_ab.max_abs_err", "wire_ab.max_rel_err",
     "wire_ab.max_ulp_err",
 })

@@ -12,8 +12,11 @@ is the CLI front-end.
 
 from .admission import (AdmissionController, BucketPricer,  # noqa: F401
                         LEDGER_METRIC, bucket_label)
+from .fairness import (DEFAULT_WEIGHTS, FairnessPolicy,  # noqa: F401
+                       WidthPolicy)
 from .intake import (Intake, PRIORITIES, ServeJob,  # noqa: F401
                      job_from_doc, validate_job)
+from .packer import SlotPlan, pack_serve_slot  # noqa: F401
 from .queue import ServeQueue, pick_serve_slot  # noqa: F401
 from .scheduler import ServeScheduler  # noqa: F401
 from .state import (JOB_STATES, LIVE_STATES, make_state,  # noqa: F401

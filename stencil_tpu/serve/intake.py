@@ -59,6 +59,10 @@ class ServeJob(TenantJob):
     owner: str = ""
     priority: str = "normal"
     seq: int = 0
+    # when the LIVE queue admitted the job (the aging clock's zero);
+    # None until queued. Deliberately absent from spec_doc: a revived
+    # job's wait restarts — aging measures THIS daemon's debt to it.
+    admit_t: Optional[float] = None
 
     def order_key(self) -> Tuple[int, float, int]:
         """The LIVE queue's scheduling order: priority class, then

@@ -25,14 +25,30 @@ from .intake import ServeJob
 
 class ServeQueue:
     """A small always-sorted job list (serving queues are tens of jobs;
-    sort-on-admit keeps every scan trivially in policy order)."""
+    sort-on-access keeps every scan trivially in policy order).
 
-    def __init__(self):
+    With no ``policy`` the order is :meth:`ServeJob.order_key` — strict
+    priority, PR 19's rule. A :class:`~.fairness.FairnessPolicy` makes
+    the order TIME-DEPENDENT (aged rank decays while a job waits), so
+    the queue re-sorts on access rather than only on admit; admission
+    also stamps ``job.admit_t``, the aging clock's zero."""
+
+    def __init__(self, policy=None):
         self._items: List[ServeJob] = []
+        self._policy = policy
+
+    def _sort(self, now=None) -> None:
+        if self._policy is not None:
+            self._items.sort(
+                key=lambda j: self._policy.queue_key(j, now))
+        else:
+            self._items.sort(key=ServeJob.order_key)
 
     def admit(self, job: ServeJob) -> None:
+        if self._policy is not None and job.admit_t is None:
+            job.admit_t = self._policy.clock()
         self._items.append(job)
-        self._items.sort(key=ServeJob.order_key)
+        self._sort()
 
     def remove(self, job: ServeJob) -> None:
         self._items.remove(job)
@@ -40,13 +56,15 @@ class ServeQueue:
     def peek(self) -> ServeJob:
         if not self._items:
             raise RuntimeError("peek on an empty serve queue")
+        self._sort()
         return self._items[0]
 
-    def jobs(self) -> List[ServeJob]:
+    def jobs(self, now=None) -> List[ServeJob]:
+        self._sort(now)
         return list(self._items)
 
     def __iter__(self) -> Iterator[ServeJob]:
-        return iter(list(self._items))
+        return iter(self.jobs())
 
     def __len__(self) -> int:
         return len(self._items)
